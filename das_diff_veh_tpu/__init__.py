@@ -1,0 +1,24 @@
+"""das_diff_veh_tpu — TPU-native framework for vehicle-induced DAS seismic imaging.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of the reference
+``NohPei/das_diff_veh`` codebase (near-surface characterization from
+vehicle-induced surface waves on DAS fiber):
+
+- DAS data I/O (npz + native SEG-Y parser) and preprocessing
+- Kalman-filter vehicle tracking (``lax.scan`` over channels)
+- Surface-wave window selection + trajectory-aware muting (static-shape batches)
+- Virtual-shot-gather interferometry (batched circular FFT cross-correlation)
+- Phase-velocity (f-v) dispersion imaging (fk bilinear sampling + slant stack)
+- Vehicle speed/weight classification and bootstrap dispersion uncertainty
+- Differentiable Rayleigh-wave forward model + optax/CPSO Vs inversion
+- Multi-device sharding over ``jax.sharding.Mesh`` (windows, channels, particles)
+
+All compute kernels are pure functions over pytrees; a NumPy/SciPy oracle
+(``das_diff_veh_tpu.oracle``) mirrors the reference semantics for equivalence
+testing and speedup measurement.
+"""
+
+__version__ = "0.1.0"
+
+from das_diff_veh_tpu.core.section import DasSection  # noqa: F401
+from das_diff_veh_tpu import config  # noqa: F401

@@ -1,0 +1,202 @@
+"""Typed configuration tree for the whole pipeline.
+
+The reference passes nested dicts with ``.get(key, default)`` lookups and many
+hardcoded constants (reference: apis/timeLapseImaging.py:14-19 interrogator
+table, apis/imaging_workflow.py:14-20 tracking params, hardcoded dx=8.16 at
+apis/virtual_shot_gather.py:257). Here every knob lives in one frozen
+dataclass tree so jitted functions can treat configs as static arguments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+
+@dataclass(frozen=True)
+class InterrogatorConfig:
+    """Channel geometry of one interrogator (reference: apis/timeLapseImaging.py:14-19)."""
+
+    name: str = "odh3"
+    start_ch: int = 400          # first physical channel of the fiber section
+    dx: float = 8.16             # channel spacing [m]
+    fs: float = 250.0            # sampling rate [Hz]
+
+
+@dataclass(frozen=True)
+class DetectConfig:
+    """Peak detection knobs (reference: apis/imaging_workflow.py:14-20)."""
+
+    min_prominence: float = 0.2
+    min_separation: int = 50          # samples between peaks
+    prominence_wlen: int = 600        # window for prominence evaluation
+    height: Optional[float] = None
+    max_peaks: int = 64               # static capacity for jit (padding)
+
+
+@dataclass(frozen=True)
+class TrackingConfig:
+    """Kalman-filter vehicle tracking (reference: apis/tracking.py:21-168)."""
+
+    detect: DetectConfig = field(default_factory=DetectConfig)
+    n_detect_channels: int = 15       # channels stacked for initial detection
+    likelihood_sigma: float = 0.08    # KDE sigma [s] for detection stacking
+    sigma_a: float = 0.01             # process-noise scale
+    channel_stride: int = 3           # march every `stride` channels
+    gate_lo: float = -15.0            # association gate (samples), asymmetric
+    gate_hi: float = 30.0
+    meas_noise: float = 1.0           # R
+    max_vehicles: int = 64            # static capacity for jit
+
+
+@dataclass(frozen=True)
+class TrackQCConfig:
+    """Track sanity rejection (reference: modules/car_tracking_utils.py:38-66)."""
+
+    min_valid_fraction: float = 0.3
+    retrograde_window: int = 20
+    retrograde_threshold: float = -15.0
+    min_travel_samples: float = 30.0
+    max_adjacent_nan: int = 20
+    max_jump: float = 20.0
+
+
+@dataclass(frozen=True)
+class TrackingPreprocessConfig:
+    """Quasi-static band preprocessing for tracking (reference: apis/timeLapseImaging.py:74-102)."""
+
+    flo: float = 0.08                 # temporal band [Hz]
+    fhi: float = 1.0
+    subsample: int = 5                # 250 Hz -> 50 Hz
+    target_dx: float = 1.0            # spatial resample 8.16 m -> 1 m
+    flo_space: float = 0.006          # spatial band [cycles/m]
+    fhi_space: float = 0.04
+    noise_level: float = 10.0         # channel kill threshold (median abs)
+    empty_threshold: float = 30.0
+
+
+@dataclass(frozen=True)
+class SurfaceWavePreprocessConfig:
+    """Surface-wave band preprocessing (reference: apis/timeLapseImaging.py:51-71)."""
+
+    flo: float = 1.2                  # [Hz]
+    fhi: float = 30.0
+    noise_threshold: float = 5.0
+    impute_noisy: bool = True
+    impute_empty: bool = True
+    normalize_traces: bool = True     # per-trace L2 norm (surface_wave method)
+
+
+@dataclass(frozen=True)
+class WindowConfig:
+    """Per-vehicle surface-wave window geometry (reference: apis/data_classes.py:126-223)."""
+
+    wlen_sw: float = 8.0              # window length [s]
+    length_sw: float = 300.0          # window spatial extent [m]
+    spatial_ratio: float = 0.75       # fraction of length_sw behind the pivot
+    temporal_spacing: Optional[float] = None  # isolation spacing [s]; None -> wlen_sw
+
+
+@dataclass(frozen=True)
+class MuteConfig:
+    """Trajectory-aware muting (reference: apis/data_classes.py:49-104)."""
+
+    offset: float = 300.0             # taper width [m]
+    alpha: float = 0.3                # tukey shape
+    delta_x: float = 20.0             # asymmetric center shift [m]
+    time_alpha: float = 0.3
+
+
+@dataclass(frozen=True)
+class GatherConfig:
+    """Virtual-shot-gather interferometry (reference: apis/virtual_shot_gather.py:145-192)."""
+
+    wlen: float = 2.0                 # correlation window [s]
+    time_window: float = 4.0          # data span fed to xcorr [s]
+    delta_t: float = 1.0              # pivot-time offset [s]
+    overlap_ratio: float = 0.5
+    norm: bool = True                 # per-trace L2 norm of the gather
+    norm_amp: bool = True             # normalize by pivot-trace max
+    include_other_side: bool = True
+
+
+@dataclass(frozen=True)
+class DispersionConfig:
+    """f-v transform scan grid (reference: apis/dispersion_classes.py:11, virtual_shot_gather.py:247)."""
+
+    freq_min: float = 0.8
+    freq_max: float = 25.0
+    freq_step: float = 0.1
+    vel_min: float = 200.0
+    vel_max: float = 1200.0
+    vel_step: float = 1.0
+    sg_window: int = 25               # savgol smoothing along frequency
+    sg_order: int = 4
+    norm: bool = True                 # L1 trace norm before transform
+
+    @property
+    def n_freqs(self) -> int:
+        import numpy as np
+        return int(np.arange(self.freq_min, self.freq_max, self.freq_step).size)
+
+    @property
+    def n_vels(self) -> int:
+        import numpy as np
+        return int(np.arange(self.vel_min, self.vel_max, self.vel_step).size)
+
+
+@dataclass(frozen=True)
+class ImagingConfig:
+    """One pivot's imaging geometry (reference: imaging_diff_speed.ipynb cell 2)."""
+
+    x0: float = 700.0                 # pivot along fiber [m]
+    tracking_offset: float = 200.0    # start_x = x0 - offset, end_x = x0 + offset
+    disp_start_x: float = -150.0      # offsets fed to the dispersion transform
+    disp_end_x: float = 0.0
+
+    @property
+    def start_x(self) -> float:
+        return self.x0 - self.tracking_offset
+
+    @property
+    def end_x(self) -> float:
+        return self.x0 + self.tracking_offset
+
+
+@dataclass(frozen=True)
+class BootstrapConfig:
+    """Bootstrap uncertainty (reference: apis/imaging_classes.py:8-48, notebook cell 25)."""
+
+    bt_times: int = 30
+    bt_size: int = 60
+    sigma: Tuple[float, ...] = (25.0, 50.0, 50.0, 50.0)
+    ref_freq_idx: Tuple[int, ...] = (80, 130, 170, 170)
+    freq_lb: Tuple[float, ...] = (2.5, 10.0, 14.0, 16.0)
+    freq_ub: Tuple[float, ...] = (14.0, 15.0, 19.0, 20.0)
+    vel_max: float = 800.0
+
+
+@dataclass(frozen=True)
+class PipelineConfig:
+    """Everything, bundled. Static under jit."""
+
+    interrogator: InterrogatorConfig = field(default_factory=InterrogatorConfig)
+    tracking: TrackingConfig = field(default_factory=TrackingConfig)
+    track_qc: TrackQCConfig = field(default_factory=TrackQCConfig)
+    tracking_preprocess: TrackingPreprocessConfig = field(default_factory=TrackingPreprocessConfig)
+    sw_preprocess: SurfaceWavePreprocessConfig = field(default_factory=SurfaceWavePreprocessConfig)
+    window: WindowConfig = field(default_factory=WindowConfig)
+    mute: MuteConfig = field(default_factory=MuteConfig)
+    gather: GatherConfig = field(default_factory=GatherConfig)
+    dispersion: DispersionConfig = field(default_factory=DispersionConfig)
+    imaging: ImagingConfig = field(default_factory=ImagingConfig)
+    bootstrap: BootstrapConfig = field(default_factory=BootstrapConfig)
+    max_windows: int = 64             # static per-chunk window capacity
+
+    def replace(self, **kw) -> "PipelineConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def default_config() -> PipelineConfig:
+    return PipelineConfig()

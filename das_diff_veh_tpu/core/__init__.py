@@ -1,0 +1,1 @@
+from das_diff_veh_tpu.core.section import DasSection, VehicleTracks, WindowBatch  # noqa: F401

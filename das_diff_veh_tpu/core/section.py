@@ -1,0 +1,96 @@
+"""Core array containers, registered as JAX pytrees.
+
+The reference couples arrays to eagerly-computing classes (e.g. Dispersion
+computes in its constructor, modules/utils.py:383-405; SurfaceWaveSelector
+slices in __init__, apis/data_classes.py:168). Here containers are inert
+pytrees; all compute lives in pure functions that jit/vmap/shard cleanly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+
+def _register(cls):
+    """Register a dataclass as a pytree (all fields are leaves unless named in meta_fields)."""
+    meta = getattr(cls, "_meta_fields", ())
+    data = [f.name for f in dataclasses.fields(cls) if f.name not in meta]
+    return jax.tree_util.register_dataclass(cls, data_fields=data, meta_fields=list(meta))
+
+
+@_register
+@dataclass
+class DasSection:
+    """One (nch, nt) DAS waterfall with its axes.
+
+    Mirrors the (data, x_axis, t_axis) triple threaded through the reference
+    (modules/utils.py:169-176 read_data returns).  ``x`` is distance along the
+    fiber [m] (already interrogator-corrected), ``t`` is time [s].
+    """
+
+    data: jax.Array        # (nch, nt)
+    x: jax.Array           # (nch,)
+    t: jax.Array           # (nt,)
+
+    @property
+    def nch(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def nt(self) -> int:
+        return self.data.shape[-1]
+
+    @property
+    def dx(self) -> float:
+        return float(self.x[1] - self.x[0])
+
+    @property
+    def dt(self) -> float:
+        return float(self.t[1] - self.t[0])
+
+    def numpy(self) -> "DasSection":
+        return DasSection(np.asarray(self.data), np.asarray(self.x), np.asarray(self.t))
+
+
+@_register
+@dataclass
+class VehicleTracks:
+    """Tracked vehicle states on the tracking grid.
+
+    ``t_idx``: (max_vehicles, n_track_ch) float arrival-time *sample index* per
+    channel (NaN = no detection) — same convention as the reference's
+    ``veh_states`` (apis/tracking.py:79).  ``valid``: (max_vehicles,) bool mask
+    of live tracks after QC.  ``x``/``t``: tracking-grid axes (1 m / 50 Hz).
+    """
+
+    t_idx: jax.Array       # (max_vehicles, n_track_ch)
+    valid: jax.Array       # (max_vehicles,)
+    x: jax.Array           # (n_track_ch,)
+    t: jax.Array           # (n_track_t,)
+
+
+@_register
+@dataclass
+class WindowBatch:
+    """Static-shape batch of per-vehicle surface-wave windows.
+
+    The reference keeps a Python list of SurfaceWaveWindow objects with
+    deep-copied slices (apis/data_classes.py:211-223).  For jit we instead hold
+    one (max_windows, nx, nt_win) tensor plus a validity mask; trajectory
+    samples are stored per-window on the tracking grid (NaN-padded).
+    """
+
+    data: jax.Array        # (max_windows, nx, nt_win)
+    x: jax.Array           # (nx,) common spatial axis (offsets are window-relative)
+    t: jax.Array           # (max_windows, nt_win) absolute time axis per window
+    traj_x: jax.Array      # (max_windows, n_traj) vehicle position samples [m]
+    traj_t: jax.Array      # (max_windows, n_traj) vehicle time samples [s] (NaN-padded)
+    valid: jax.Array       # (max_windows,)
+
+    @property
+    def max_windows(self) -> int:
+        return self.data.shape[0]

@@ -1,0 +1,7 @@
+from das_diff_veh_tpu.io.readers import (  # noqa: F401
+    read_npz_section,
+    read_segy_section,
+    read_sections,
+    DirectoryDataset,
+)
+from das_diff_veh_tpu.io.synthetic import SceneConfig, synthesize_section  # noqa: F401

@@ -1,0 +1,139 @@
+"""DAS data readers and dataset iteration (host-side, numpy).
+
+Covers the reference's L1 tier: npz reader with channel-range and taper cut
+(modules/utils.py:94-113), format dispatch + multi-file time concatenation
+(modules/utils.py:116-166), and the per-date directory iterator
+(modules/imaging_IO.py:23-54).  Everything returns plain numpy; arrays cross
+onto the device at the jit boundary of the compute pipeline.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from datetime import datetime
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from das_diff_veh_tpu.core.section import DasSection
+from das_diff_veh_tpu.io import segy as _segy
+
+
+def _cut_symmetric_taper(data: np.ndarray, t: np.ndarray):
+    """Drop the pre-zero taper pad on both ends (reference: modules/utils.py:87-92).
+
+    Files store a symmetric taper region; its length is where |t| is minimal.
+    """
+    nt = data.shape[-1]
+    pad = int(np.argmin(np.abs(t)))
+    return data[:, pad:nt - pad], t[pad:nt - pad]
+
+
+def read_npz_section(path: str, ch1: Optional[float] = None, ch2: Optional[float] = None,
+                     cut_taper: bool = True) -> DasSection:
+    """Load one npz file with ``data``/``x_axis``/``t_axis`` keys
+    (reference key layout: modules/utils.py:94-113)."""
+    with np.load(path) as f:
+        data, x, t = f["data"], f["x_axis"], f["t_axis"]
+    lo = 0 if ch1 is None else int(np.argmax(x >= ch1))
+    hi = len(x) if (ch2 is None or not np.any(x >= ch2)) else int(np.argmax(x >= ch2))
+    data, x = data[lo:hi], x[lo:hi]
+    if cut_taper:
+        data, t = _cut_symmetric_taper(data, t)
+    return DasSection(np.asarray(data, dtype=np.float64), np.asarray(x, dtype=np.float64),
+                      np.asarray(t, dtype=np.float64))
+
+
+def read_segy_section(path: str, ch1: int = 0, ch2: Optional[int] = None) -> DasSection:
+    """Load a SEG-Y file via the built-in parser (segyio-free;
+    reference behavior: modules/utils.py:72-85)."""
+    data, dt, ns = _segy.read_segy(path, ch1=ch1, ch2=ch2)
+    nch = data.shape[0]
+    return DasSection(data.astype(np.float64), np.arange(ch1, ch1 + nch, dtype=np.float64),
+                      np.arange(ns) * dt)
+
+
+_READERS = {".npz": read_npz_section, ".segy": read_segy_section, ".sgy": read_segy_section}
+
+
+def read_sections(paths: Sequence[str], **kwargs) -> DasSection:
+    """Read several files and concatenate along time with accumulated shift
+    (reference: modules/utils.py:136-166)."""
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    datas, ts, t_shift, x = [], [], 0.0, None
+    for p in paths:
+        reader = _READERS[os.path.splitext(p)[-1].lower()]
+        sec = reader(p, **kwargs)
+        dt = sec.t[1] - sec.t[0]
+        datas.append(np.asarray(sec.data))
+        ts.append(np.asarray(sec.t) + t_shift)
+        t_shift += sec.t.shape[0] * dt
+        x = np.asarray(sec.x)
+    return DasSection(np.concatenate(datas, axis=-1), x, np.concatenate(ts))
+
+
+def parse_time_from_filename(path: str, fmt: str = "%Y%m%d_%H%M%S") -> datetime:
+    """Parse the acquisition timestamp from a file name
+    (reference: modules/imaging_IO.py:17-20)."""
+    return datetime.strptime(os.path.basename(path).split(".")[0], fmt)
+
+
+@dataclass
+class DirectoryDataset:
+    """Sorted iterator over the npz time-window files of one date folder
+    (reference: modules/imaging_IO.py:23-54).
+
+    The reference hardcodes a Savitzky-Golay pre-smooth (21,15) and a magic
+    amplitude rescale ``6463.81735715902`` for dates > '20230219'
+    (modules/imaging_IO.py:41-46); both are explicit knobs here.
+    """
+
+    directory: str
+    root: str = "."
+    ch1: float = 400
+    ch2: float = 540
+    smoothing: bool = True
+    sg_window: int = 21
+    sg_order: int = 15
+    rescale_after: Optional[str] = "20230219"
+    rescale_value: float = 6463.81735715902
+
+    def __post_init__(self):
+        folder = os.path.join(self.root, self.directory)
+        files = [os.path.join(folder, f) for f in os.listdir(folder) if f.endswith(".npz")]
+        files.sort(key=os.path.basename)
+        self.files = files
+
+    def time_interval(self) -> float:
+        """Seconds between consecutive files (reference: modules/imaging_IO.py:31-35)."""
+        a = parse_time_from_filename(self.files[0])
+        b = parse_time_from_filename(self.files[1])
+        return (b - a).total_seconds()
+
+    def __len__(self) -> int:
+        return len(self.files)
+
+    def __getitem__(self, idx: int) -> DasSection:
+        path = self.files[idx]
+        sec = read_npz_section(path, ch1=self.ch1, ch2=self.ch2)
+        data = np.asarray(sec.data)
+        if self.smoothing:
+            from scipy.signal import savgol_filter
+            data = savgol_filter(data, self.sg_window, self.sg_order)
+        if self.rescale_after is not None:
+            date = os.path.basename(os.path.dirname(path))
+            if date > self.rescale_after:
+                data = data / self.rescale_value
+        return DasSection(data, sec.x, sec.t)
+
+    def __iter__(self) -> Iterator[DasSection]:
+        for i in range(len(self)):
+            yield self[i]
+
+
+def save_section_npz(path: str, section: DasSection) -> None:
+    """Write the reference npz layout so files round-trip between frameworks."""
+    np.savez(path, data=np.asarray(section.data), x_axis=np.asarray(section.x),
+             t_axis=np.asarray(section.t))

@@ -1,0 +1,16 @@
+"""Test configuration: force a virtual 8-device CPU platform.
+
+Multi-chip hardware is unavailable in CI; sharding paths are exercised on a
+fake 8-device CPU mesh exactly as the driver's dryrun does.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,94 @@
+import numpy as np
+import pytest
+
+from das_diff_veh_tpu.core.section import DasSection
+from das_diff_veh_tpu.io import readers, segy
+from das_diff_veh_tpu.io.synthetic import SceneConfig, synthesize_section, dispersive_shot
+
+
+def test_npz_roundtrip(tmp_path):
+    sec = DasSection(np.random.randn(8, 100), np.arange(8.0), np.arange(100) * 0.004)
+    p = str(tmp_path / "20230101_000000.npz")
+    readers.save_section_npz(p, sec)
+    back = readers.read_npz_section(p, cut_taper=False)
+    np.testing.assert_allclose(back.data, sec.data)
+    np.testing.assert_allclose(back.x, sec.x)
+
+
+def test_npz_channel_range_and_taper(tmp_path):
+    nt = 120
+    t = (np.arange(nt) - 10) * 0.004      # taper pad: 10 samples, t crosses zero at idx 10
+    sec = DasSection(np.random.randn(16, nt), np.arange(400.0, 416.0), t)
+    p = str(tmp_path / "a.npz")
+    readers.save_section_npz(p, sec)
+    back = readers.read_npz_section(p, ch1=404, ch2=410)
+    assert back.data.shape == (6, nt - 20)
+    assert back.x[0] == 404
+
+
+def test_segy_roundtrip(tmp_path):
+    data = np.random.randn(12, 250).astype(np.float32)
+    p = str(tmp_path / "a.segy")
+    segy.write_segy(p, data, dt=0.004)
+    back, dt, ns = segy.read_segy(p)
+    assert ns == 250 and abs(dt - 0.004) < 1e-9
+    np.testing.assert_allclose(back, data, rtol=1e-6)
+    sub, _, _ = segy.read_segy(p, ch1=2, ch2=5)
+    np.testing.assert_allclose(sub, data[2:5], rtol=1e-6)
+
+
+def test_segy_ibm_float():
+    # 0x42640000 = +100.0 in IBM hex float
+    raw = np.array([0x42640000, 0xC2640000, 0x41100000], dtype=np.uint32)
+    vals = segy._ibm_to_float(raw)
+    np.testing.assert_allclose(vals, [100.0, -100.0, 1.0])
+
+
+def test_multi_file_concat(tmp_path):
+    dt = 0.004
+    s1 = DasSection(np.ones((4, 50)), np.arange(4.0), np.arange(50) * dt)
+    s2 = DasSection(2 * np.ones((4, 60)), np.arange(4.0), np.arange(60) * dt)
+    p1, p2 = str(tmp_path / "x1.npz"), str(tmp_path / "x2.npz")
+    readers.save_section_npz(p1, s1)
+    readers.save_section_npz(p2, s2)
+    out = readers.read_sections([p1, p2], cut_taper=False)
+    assert out.data.shape == (4, 110)
+    # time axis continues across the file boundary
+    assert out.t[50] == pytest.approx(50 * dt)
+
+
+def test_directory_dataset(tmp_path):
+    d = tmp_path / "20230101"
+    d.mkdir()
+    for h in (0, 1):
+        sec = DasSection(np.random.randn(8, 100), np.arange(400.0, 408.0),
+                         np.arange(100) * 0.004)
+        readers.save_section_npz(str(d / f"20230101_0{h}0000.npz"), sec)
+    ds = readers.DirectoryDataset("20230101", root=str(tmp_path), ch1=400, ch2=408,
+                                  smoothing=False)
+    assert len(ds) == 2
+    assert ds.time_interval() == 3600.0
+    sec = ds[0]
+    assert sec.data.shape[0] == 8
+
+
+def test_synthetic_scene_shapes_and_truth():
+    cfg = SceneConfig(nch=48, duration=60.0, n_vehicles=3, seed=1)
+    sec, truth = synthesize_section(cfg)
+    assert sec.data.shape == (48, 15000)
+    assert truth.speed.shape == (3,)
+    # quasi-static deflection is negative near each vehicle's arrival
+    x = np.asarray(sec.x)
+    t_arr = truth.arrival_times(x)
+    v, ch = 0, 20
+    ti = int(round(t_arr[v, ch] * cfg.fs))
+    if 0 <= ti < sec.data.shape[1]:
+        assert sec.data[ch, ti] < 0
+
+
+def test_dispersive_shot_moveout():
+    # far channel peaks later than near channel
+    d = dispersive_shot(nx=32, nt=2000, dx=8.16, dt=0.004)
+    p_near = np.argmax(np.abs(d[1]))
+    p_far = np.argmax(np.abs(d[30]))
+    assert p_far > p_near
