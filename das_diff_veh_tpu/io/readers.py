@@ -36,6 +36,8 @@ def read_npz_section(path: str, ch1: Optional[float] = None, ch2: Optional[float
     (reference key layout: modules/utils.py:94-113)."""
     with np.load(path) as f:
         data, x, t = f["data"], f["x_axis"], f["t_axis"]
+    if ch1 is not None and not np.any(x >= ch1):
+        raise ValueError(f"ch1={ch1} beyond channel axis [{x[0]}, {x[-1]}] in {path}")
     lo = 0 if ch1 is None else int(np.argmax(x >= ch1))
     hi = len(x) if (ch2 is None or not np.any(x >= ch2)) else int(np.argmax(x >= ch2))
     data, x = data[lo:hi], x[lo:hi]
@@ -45,10 +47,13 @@ def read_npz_section(path: str, ch1: Optional[float] = None, ch2: Optional[float
                       np.asarray(t, dtype=np.float64))
 
 
-def read_segy_section(path: str, ch1: int = 0, ch2: Optional[int] = None) -> DasSection:
+def read_segy_section(path: str, ch1: int = 0, ch2: Optional[int] = None,
+                      **_ignored) -> DasSection:
     """Load a SEG-Y file via the built-in parser (segyio-free;
-    reference behavior: modules/utils.py:72-85)."""
-    data, dt, ns = _segy.read_segy(path, ch1=ch1, ch2=ch2)
+    reference behavior: modules/utils.py:72-85).  ``ch1``/``ch2`` are trace
+    indices; npz-only kwargs (e.g. cut_taper) are accepted and ignored so
+    mixed-format lists work through ``read_sections``."""
+    data, dt, ns = _segy.read_segy(path, ch1=int(ch1), ch2=None if ch2 is None else int(ch2))
     nch = data.shape[0]
     return DasSection(data.astype(np.float64), np.arange(ch1, ch1 + nch, dtype=np.float64),
                       np.arange(ns) * dt)
@@ -108,6 +113,10 @@ class DirectoryDataset:
 
     def time_interval(self) -> float:
         """Seconds between consecutive files (reference: modules/imaging_IO.py:31-35)."""
+        if len(self.files) < 2:
+            raise ValueError(
+                f"need >= 2 npz files in {os.path.join(self.root, self.directory)} "
+                f"to infer the window interval (found {len(self.files)})")
         a = parse_time_from_filename(self.files[0])
         b = parse_time_from_filename(self.files[1])
         return (b - a).total_seconds()

@@ -1,0 +1,17 @@
+from das_diff_veh_tpu.ops.filters import (  # noqa: F401
+    bandpass_time,
+    bandpass_space,
+    tukey_window,
+    taper_time,
+    detrend_linear,
+    remove_common_mode,
+    das_preprocess,
+)
+from das_diff_veh_tpu.ops.savgol import savgol_filter  # noqa: F401
+from das_diff_veh_tpu.ops.resample import resample_poly  # noqa: F401
+from das_diff_veh_tpu.ops.psd import welch_psd  # noqa: F401
+from das_diff_veh_tpu.ops.qc import (  # noqa: F401
+    noisy_trace_mask,
+    empty_trace_mask,
+    impute_traces,
+)

@@ -1,0 +1,59 @@
+"""Welch power spectral density (jnp), matching scipy.signal.welch defaults.
+
+The reference averages Welch PSDs per channel per window
+(modules/utils.py:715-728, virtual_shot_gather.py:55) with scipy defaults:
+hann window, 50% overlap, constant detrend, density scaling.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _hann_periodic(n: int, dtype) -> jnp.ndarray:
+    """Periodic hann — what ``scipy.signal.get_window('hann')`` returns."""
+    k = jnp.arange(n, dtype=dtype)
+    return 0.5 - 0.5 * jnp.cos(2.0 * jnp.pi * k / n)
+
+
+def welch_psd(data: jnp.ndarray, fs: float, nperseg: int = 2048,
+              noverlap: int | None = None, nfft: int | None = None):
+    """Welch PSD along the last axis.  Returns (freqs, Pxx).
+
+    Matches ``scipy.signal.welch(..., window='hann', detrend='constant',
+    scaling='density')``; if the signal is shorter than ``nperseg`` scipy
+    shrinks the segment — we require nperseg <= n instead (static shapes).
+    """
+    n = data.shape[-1]
+    nperseg = min(nperseg, n)
+    if noverlap is None:
+        noverlap = nperseg // 2
+    if nfft is None:
+        nfft = nperseg
+    step = nperseg - noverlap
+    nseg = (n - noverlap) // step
+
+    idx = (jnp.arange(nseg)[:, None] * step + jnp.arange(nperseg)[None, :])
+    segs = data[..., idx]                               # (..., nseg, nperseg)
+    segs = segs - jnp.mean(segs, axis=-1, keepdims=True)
+    win = _hann_periodic(nperseg, data.dtype)
+    spec = jnp.fft.rfft(segs * win, n=nfft, axis=-1)
+    scale = 1.0 / (fs * jnp.sum(win * win))
+    p = (jnp.abs(spec) ** 2) * scale
+    # one-sided: double everything but DC (and Nyquist when nfft even)
+    if nfft % 2 == 0:
+        mult = jnp.concatenate([jnp.ones(1), 2 * jnp.ones(nfft // 2 - 1), jnp.ones(1)])
+    else:
+        mult = jnp.concatenate([jnp.ones(1), 2 * jnp.ones((nfft - 1) // 2)])
+    p = p * mult.astype(data.dtype)
+    freqs = jnp.fft.rfftfreq(nfft, d=1.0 / fs)
+    return freqs, jnp.mean(p, axis=-2)
+
+
+def stack_avg_psd(window_data: jnp.ndarray, fs: float, nperseg: int = 2048):
+    """Average PSD over channels then windows (reference win_avg_psd,
+    modules/utils.py:715-728).  ``window_data``: (nwin, nch, nt)."""
+    freqs, p = welch_psd(window_data, fs, nperseg=nperseg)   # (nwin, nch, nf)
+    per_window = jnp.mean(p, axis=1)
+    return freqs, jnp.mean(per_window, axis=0), per_window

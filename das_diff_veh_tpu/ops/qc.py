@@ -1,0 +1,60 @@
+"""Trace quality control: bad-channel detection and imputation.
+
+The reference finds ONE noisy/empty channel per call (argmax) and imputes it
+by neighbor averaging (modules/utils.py:316-329) — a latent bug when several
+channels are bad.  The TPU-native version is fully vectorized: boolean masks
+over all channels, one-shot neighbor imputation, no data-dependent shapes.
+A strict single-index variant is kept for oracle-parity tests.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def noisy_trace_mask(data: jnp.ndarray, threshold: float = 5.0) -> jnp.ndarray:
+    """Channels whose max amplitude exceeds ``threshold``
+    (reference find_noise_idx(empty_tr=False), modules/utils.py:316-318)."""
+    return jnp.max(data, axis=-1) > threshold
+
+
+def empty_trace_mask(data: jnp.ndarray, threshold: float = 5.0) -> jnp.ndarray:
+    """Channels whose L2 norm is below ``threshold``
+    (reference find_noise_idx(empty_tr=True), modules/utils.py:319-320)."""
+    return jnp.linalg.norm(data, axis=-1) < threshold
+
+
+def impute_traces(data: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Replace masked channels by the sum of their immediate neighbors
+    (edge channels copy the single neighbor) — the reference's per-channel
+    rule (modules/utils.py:323-329), applied to every masked channel at once.
+    """
+    up = jnp.roll(data, -1, axis=0)
+    down = jnp.roll(data, 1, axis=0)
+    nch = data.shape[0]
+    repl = up + down
+    repl = repl.at[0].set(up[0])
+    repl = repl.at[nch - 1].set(down[nch - 1])
+    return jnp.where(mask[:, None], repl, data)
+
+
+def impute_first_noisy(data: jnp.ndarray, threshold: float = 5.0,
+                       empty: bool = False) -> jnp.ndarray:
+    """Strict reference semantics: impute only argmax of the predicate
+    (modules/utils.py:316-329).  Used for oracle equivalence tests."""
+    if empty:
+        idx = jnp.argmax(jnp.linalg.norm(data, axis=-1) < threshold)
+    else:
+        idx = jnp.argmax(jnp.max(data, axis=-1) > threshold)
+    nch = data.shape[0]
+    prev = data[jnp.clip(idx - 1, 0, nch - 1)]
+    nxt = data[jnp.clip(idx + 1, 0, nch - 1)]
+    repl = jnp.where(idx == 0, nxt, jnp.where(idx == nch - 1, prev, prev + nxt))
+    return data.at[idx].set(repl)
+
+
+def kill_loud_channels(data: jnp.ndarray, noise_level: float = 10.0) -> jnp.ndarray:
+    """Zero out channels whose median |amplitude| exceeds ``noise_level``
+    (reference: apis/timeLapseImaging.py:76-77)."""
+    loud = jnp.median(jnp.abs(data), axis=-1) > noise_level
+    return jnp.where(loud[:, None], 0.0, data)

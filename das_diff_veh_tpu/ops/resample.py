@@ -1,0 +1,59 @@
+"""Polyphase rational resampling (scipy.signal.resample_poly equivalent).
+
+The tracking preprocessor upsamples the channel axis 8.16 m -> 1 m with
+``signal.resample_poly(data, 204, 25)`` (reference:
+apis/timeLapseImaging.py:91).  The TPU path builds the identical default
+Kaiser anti-alias FIR on the host and expresses up-firdn as zero-stuffing +
+one ``conv_general_dilated`` — a single fused XLA convolution.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax.lax as lax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.lru_cache(maxsize=16)
+def _default_filter(up: int, down: int) -> np.ndarray:
+    """scipy.signal.resample_poly's default anti-alias FIR (kaiser beta=5)."""
+    from scipy.signal import firwin
+    max_rate = max(up, down)
+    f_c = 1.0 / max_rate
+    half_len = 10 * max_rate
+    h = firwin(2 * half_len + 1, f_c, window=("kaiser", 5.0))
+    return np.asarray(h, dtype=np.float64) * up
+
+
+def resample_poly(data: jnp.ndarray, up: int, down: int, axis: int = 0) -> jnp.ndarray:
+    """Rational-rate polyphase resample along ``axis``; matches
+    ``scipy.signal.resample_poly`` (default window, zero padding)."""
+    g = math.gcd(up, down)
+    up, down = up // g, down // g
+    if up == 1 and down == 1:
+        return data
+    h = _default_filter(up, down)
+    n_out = -(-data.shape[axis] * up // down)          # ceil
+
+    moved = jnp.moveaxis(data, axis, -1)
+    shape = moved.shape
+    flat = moved.reshape(-1, shape[-1])                # (batch, n)
+    n = flat.shape[-1]
+
+    # zero-stuff: x_up[i*up] = x[i]
+    up_len = n * up
+    upped = jnp.zeros((flat.shape[0], up_len), dtype=flat.dtype)
+    upped = upped.at[:, ::up].set(flat)
+
+    # scipy centers the filter: output sample j taps x_up[j*down - k + half]
+    half = (len(h) - 1) // 2
+    k = jnp.asarray(h[::-1].copy(), dtype=flat.dtype)
+    lhs = upped[:, None, :]
+    rhs = k[None, None, :]
+    full = lax.conv_general_dilated(lhs, rhs, window_strides=(down,),
+                                    padding=[(half, half + (len(h) - 1) % 2)])[:, 0, :]
+    out = full[:, :n_out]
+    return jnp.moveaxis(out.reshape(shape[:-1] + (n_out,)), -1, axis)

@@ -1,0 +1,66 @@
+"""Savitzky-Golay smoothing as convolution + edge-projection matmuls.
+
+The reference calls ``scipy.signal.savgol_filter`` in four places (fv-map
+smooth (25,4) modules/utils.py:473, ridge smooth (25,2) modules/utils.py:676,
+file pre-smooth (21,15) modules/imaging_IO.py:45, quasi-static smooth (101,3)
+imaging_diff_speed.ipynb cell 5).  scipy's default ``mode='interp'`` fits a
+polynomial to the first/last window for the edge samples; both the interior
+convolution and the edge fits are linear maps, so the whole filter is one
+correlation plus two small matmuls — precomputed on the host, applied in jnp.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.lru_cache(maxsize=32)
+def _savgol_matrices(window: int, order: int):
+    """(conv_coeffs (window,), left_edge (half, window), right_edge (half, window))."""
+    from scipy.signal import savgol_coeffs
+    coeffs = savgol_coeffs(window, order)              # interior correlation kernel
+    half = window // 2
+    # polynomial LS projection for edges: fit first/last `window` samples,
+    # evaluate the fitted polynomial at positions 0..half-1 (left) and
+    # window-half..window-1 (right) — exactly scipy's mode='interp'.
+    # centered positions: mathematically the same projection as scipy's
+    # uncentered polyfit, but vastly better conditioned at high order
+    pos = np.arange(window, dtype=np.float64) - half
+    V = np.vander(pos, order + 1, increasing=True)     # (window, order+1)
+    proj = V @ np.linalg.pinv(V)                       # (window, window) LS smoother
+    left = proj[:half]                                 # first half outputs
+    right = proj[window - half:]                       # last half outputs
+    return (np.asarray(coeffs, dtype=np.float64), left, right)
+
+
+def savgol_filter(data: jnp.ndarray, window: int, order: int, axis: int = -1) -> jnp.ndarray:
+    """Savitzky-Golay filter matching ``scipy.signal.savgol_filter(mode='interp')``."""
+    coeffs, left, right = _savgol_matrices(window, order)
+    half = window // 2
+
+    moved = jnp.moveaxis(data, axis, -1)
+    shape = moved.shape
+    flat = moved.reshape(-1, shape[-1])                # (batch, n)
+    n = flat.shape[-1]
+    if n < window:
+        raise ValueError(f"savgol window {window} longer than axis length {n}")
+
+    k = jnp.asarray(coeffs[::-1], dtype=flat.dtype)    # correlate == conv w/ reversed
+    # vectorized 'same' correlation via conv_general_dilated
+    import jax.lax as lax
+    lhs = flat[:, None, :]                             # (batch, 1, n)
+    rhs = k[None, None, :]                             # (1, 1, window)
+    out = lax.conv_general_dilated(lhs, rhs, window_strides=(1,),
+                                   padding=[(half, half)])[:, 0, :]
+
+    lmat = jnp.asarray(left, dtype=flat.dtype)
+    rmat = jnp.asarray(right, dtype=flat.dtype)
+    head = flat[:, :window] @ lmat.T                   # (batch, half)
+    tail = flat[:, n - window:] @ rmat.T               # (batch, half)
+    out = out.at[:, :half].set(head)
+    out = out.at[:, n - half:].set(tail)
+
+    return jnp.moveaxis(out.reshape(shape), -1, axis)

@@ -1,16 +1,22 @@
 """Test configuration: force a virtual 8-device CPU platform.
 
 Multi-chip hardware is unavailable in CI; sharding paths are exercised on a
-fake 8-device CPU mesh exactly as the driver's dryrun does.
+fake 8-device CPU mesh exactly as the driver's dryrun does.  The session may
+export JAX_PLATFORMS=axon (single tunneled TPU chip) — tests override it.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+os.environ["JAX_ENABLE_X64"] = "1"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_platforms", "cpu")
