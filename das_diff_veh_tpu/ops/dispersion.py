@@ -1,0 +1,145 @@
+"""f-k and frequency-velocity (dispersion) transforms.
+
+Two paths:
+
+- ``fv_map_fk``: parity with the reference's ``map_fv``
+  (modules/utils.py:457-475): 2-D FFT magnitude (``fk``, modules/
+  utils.py:236-248), bilinear sampling along k = f/v, Savitzky-Golay (25,4)
+  smoothing over frequency.  The reference samples with the long-removed
+  ``scipy.interpolate.interp2d`` (linear spline); our bilinear gather keeps
+  the *unclamped* fractional coordinate in the edge cell, which reproduces
+  the linear-spline extrapolation outside the f-k grid bug-for-bug.
+
+- ``fv_map_phase_shift``: the frequency-domain slant stack
+  P(v, f) = |Σ_x U(x, f) e^{i 2π f x / v}| (Park et al. phase-shift method)
+  — the physics the reference's dead ``map_fv_FD_slant_stack``
+  (modules/utils.py:429-454) loops over, here as one batched complex
+  contraction with optional spectral whitening.  Preferred on TPU: no
+  oversized zero-padded FFT, no gather, all MXU-friendly.
+
+Both return (nvel, nfreq) maps; stacking over windows is a mean over a
+leading batch axis (replacing the reference's __add__/__truediv__ algebra,
+modules/utils.py:412-426).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from das_diff_veh_tpu.ops.savgol import savgol_filter
+
+
+def _next_pow2_plus(n: int) -> int:
+    """Reference's padded FFT size: 2 ** (1 + ceil(log2 n)) (modules/utils.py:239-240)."""
+    return 2 ** (1 + math.ceil(math.log2(n)))
+
+
+def fk_transform(data: jnp.ndarray, dx: float, dt: float):
+    """2-D f-k magnitude spectrum with fftshifted axes
+    (reference ``fk``, modules/utils.py:236-248).
+
+    Returns (fk_mag (nk, nf), f_axis (nf,), k_axis (nk,)).
+    """
+    nch, nt = data.shape[-2], data.shape[-1]
+    nf = _next_pow2_plus(nt)
+    nk = _next_pow2_plus(nch)
+    spec = jnp.fft.fftshift(jnp.fft.fft2(data, s=(nk, nf)), axes=(-2, -1))
+    f_axis = jnp.arange(-nf / 2, nf / 2) / nf / dt
+    k_axis = jnp.arange(-nk / 2, nk / 2) / nk / dx
+    return jnp.abs(spec), f_axis, k_axis
+
+
+def _bilinear_clamped(grid: jnp.ndarray, u: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    """Tensor-product linear interpolation on a regular grid; out-of-domain
+    queries are clamped to the boundary — FITPACK's bisplev behavior, i.e.
+    what both the removed ``interp2d`` and ``RectBivariateSpline(kx=ky=1)``
+    do for the k = f/v samples beyond spatial Nyquist."""
+    n0, n1 = grid.shape
+    u = jnp.clip(u, 0.0, n0 - 1.0)
+    v = jnp.clip(v, 0.0, n1 - 1.0)
+    i0 = jnp.clip(jnp.floor(u).astype(jnp.int32), 0, n0 - 2)
+    i1 = jnp.clip(jnp.floor(v).astype(jnp.int32), 0, n1 - 2)
+    w0 = u - i0
+    w1 = v - i1
+    g00 = grid[i0, i1]
+    g01 = grid[i0, i1 + 1]
+    g10 = grid[i0 + 1, i1]
+    g11 = grid[i0 + 1, i1 + 1]
+    return ((1 - w0) * (1 - w1) * g00 + (1 - w0) * w1 * g01 +
+            w0 * (1 - w1) * g10 + w0 * w1 * g11)
+
+
+def fv_map_fk(data: jnp.ndarray, dx: float, dt: float, freqs: jnp.ndarray,
+              vels: jnp.ndarray, norm: bool = False,
+              sg_window: int = 25, sg_order: int = 4) -> jnp.ndarray:
+    """Reference-parity dispersion map (``map_fv``, modules/utils.py:457-475).
+
+    Returns (nvel, nfreq).  ``norm`` applies the per-trace L1 normalization
+    the reference applies before the transform (modules/utils.py:464).
+    """
+    if norm:
+        data = data / jnp.linalg.norm(data, axis=-1, keepdims=True, ord=1)
+    fk_mag, f_axis, k_axis = fk_transform(data, dx, dt)
+    # uniform axes -> index arithmetic instead of searchsorted
+    f0, df = f_axis[0], f_axis[1] - f_axis[0]
+    k0, dk = k_axis[0], k_axis[1] - k_axis[0]
+    fr = jnp.asarray(freqs)
+    vl = jnp.asarray(vels)
+    kq = fr[None, :] / vl[:, None]                      # (nvel, nfreq) k = f/v
+    fq = jnp.broadcast_to(fr[None, :], kq.shape)
+    # grid layout: fk_mag[k, f]
+    vals = _bilinear_clamped(fk_mag, (kq - k0) / dk, (fq - f0) / df)  # (nvel, nfreq)
+    smoothed = savgol_filter(vals, sg_window, sg_order, axis=-1)      # over frequency
+    return smoothed
+
+
+def fv_map_phase_shift(data: jnp.ndarray, dx: float, dt: float, freqs: jnp.ndarray,
+                       vels: jnp.ndarray, whiten: bool = True,
+                       x0: float = 0.0, direction: float = 1.0,
+                       vel_chunk: int = 128) -> jnp.ndarray:
+    """Phase-shift (frequency-domain slant stack) dispersion map.
+
+    P(v, f) = | Σ_x U(x, f) e^{i·direction·2π f (x - x0) / v} |, with optional
+    spectral whitening U → U/|U| (standard MASW practice).  ``direction=+1``
+    stacks waves propagating toward *increasing* x (delay grows with x);
+    ``-1`` the opposite — match it to the gather's propagation sense (the
+    reference's one-sided gathers run offsets -150..0 m with the virtual
+    source at 0, i.e. direction=-1 in slice coordinates).  Velocity axis is
+    processed in chunks to bound the steering-tensor footprint.
+    Returns (nvel, nfreq).
+    """
+    nch, nt = data.shape[-2], data.shape[-1]
+    spec = jnp.fft.rfft(data, axis=-1)                  # (nch, nfr)
+    fft_freqs = jnp.fft.rfftfreq(nt, d=dt)
+    if whiten:
+        spec = spec / (jnp.abs(spec) + 1e-20)
+    # sample the data spectrum at the scan frequencies (nearest bin — the scan
+    # step 0.1 Hz is finer than typical bin spacing, matching reference's
+    # nearest-bin pick in map_fv_FD_slant_stack modules/utils.py:451)
+    fbin = jnp.clip(jnp.round(jnp.asarray(freqs) * nt * dt).astype(jnp.int32),
+                    0, fft_freqs.shape[0] - 1)
+    u = spec[:, fbin]                                   # (nch, nfreq)
+    x = (jnp.arange(nch) * dx - x0)
+    fr = jnp.asarray(freqs)
+
+    def chunk(vc):
+        # steering: (nvc, nfreq, nch)
+        phase = 2.0 * jnp.pi * fr[None, :, None] * x[None, None, :] / vc[:, None, None]
+        steer = jnp.exp(1j * direction * phase)
+        return jnp.abs(jnp.einsum("xf,vfx->vf", u, steer))
+
+    vl = jnp.asarray(vels)
+    nv = vl.shape[0]
+    pad = (-nv) % vel_chunk
+    vl_pad = jnp.concatenate([vl, jnp.full((pad,), vl[-1])]) if pad else vl
+    out = jax.lax.map(chunk, vl_pad.reshape(-1, vel_chunk))
+    return out.reshape(-1, fr.shape[0])[:nv]
+
+
+def stack_fv_maps(maps: jnp.ndarray) -> jnp.ndarray:
+    """Average a (nwin, nvel, nfreq) batch — replaces the reference's
+    Dispersion __add__/__truediv__ stacking (modules/utils.py:412-426)."""
+    return jnp.mean(maps, axis=0)

@@ -31,6 +31,11 @@ def welch_psd(data: jnp.ndarray, fs: float, nperseg: int = 2048,
         noverlap = nperseg // 2
     if nfft is None:
         nfft = nperseg
+    if nfft < nperseg:
+        raise ValueError(f"nfft ({nfft}) must be >= nperseg ({nperseg})")
+    if noverlap >= nperseg:
+        raise ValueError(f"noverlap ({noverlap}) must be < nperseg ({nperseg}; "
+                         f"note nperseg shrinks to the signal length {n})")
     step = nperseg - noverlap
     nseg = (n - noverlap) // step
 

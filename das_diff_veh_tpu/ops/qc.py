@@ -1,8 +1,8 @@
 """Trace quality control: bad-channel detection and imputation.
 
 The reference finds ONE noisy/empty channel per call (argmax) and imputes it
-by neighbor averaging (modules/utils.py:316-329) — a latent bug when several
-channels are bad.  The TPU-native version is fully vectorized: boolean masks
+by neighbor *summing* (no /2; modules/utils.py:327) — a latent bug when
+several channels are bad.  The TPU-native version is fully vectorized: boolean masks
 over all channels, one-shot neighbor imputation, no data-dependent shapes.
 A strict single-index variant is kept for oracle-parity tests.
 """

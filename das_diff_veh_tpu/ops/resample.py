@@ -54,6 +54,6 @@ def resample_poly(data: jnp.ndarray, up: int, down: int, axis: int = 0) -> jnp.n
     lhs = upped[:, None, :]
     rhs = k[None, None, :]
     full = lax.conv_general_dilated(lhs, rhs, window_strides=(down,),
-                                    padding=[(half, half + (len(h) - 1) % 2)])[:, 0, :]
+                                    padding=[(half, half)])[:, 0, :]
     out = full[:, :n_out]
     return jnp.moveaxis(out.reshape(shape[:-1] + (n_out,)), -1, axis)

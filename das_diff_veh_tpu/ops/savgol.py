@@ -45,6 +45,8 @@ def savgol_filter(data: jnp.ndarray, window: int, order: int, axis: int = -1) ->
     shape = moved.shape
     flat = moved.reshape(-1, shape[-1])                # (batch, n)
     n = flat.shape[-1]
+    if window % 2 == 0:
+        raise ValueError(f"savgol window must be odd, got {window}")
     if n < window:
         raise ValueError(f"savgol window {window} longer than axis length {n}")
 

@@ -1,0 +1,126 @@
+"""Windowed cross-correlation engine — the hot kernel of the framework.
+
+The reference computes, per 50%-overlap window, ``signal.correlate(doubled
+source, receiver, mode='valid', method='fft')`` where the source window is
+circularly doubled via ``repeat1d`` (reference modules/utils.py:250-270
+XCORR_two_traces; :289-314 XCORR_vshot — a Python double loop of
+nwin x nch FFT calls).  That "doubled + valid" scheme is exactly *circular*
+cross-correlation of the two windows:
+
+    c[k] = sum_n src[(n+k) mod W] * rcv[n] = irfft( rfft(src) * conj(rfft(rcv)) )
+
+so one virtual-shot gather collapses to a single batched rfft over
+(channel, window) tiles, one elementwise complex product, and one irfft —
+fully MXU/VPU-friendly, no Python loops, vmappable over windows and shards
+over channels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sliding_windows(trace_or_data: jnp.ndarray, wlen: int, offset: int) -> jnp.ndarray:
+    """Cut 1-D (or (nch, nt)) data into ``nwin`` windows of ``wlen`` samples
+    every ``offset`` samples: returns (..., nwin, wlen)."""
+    nt = trace_or_data.shape[-1]
+    nwin = (nt - wlen) // offset + 1
+    idx = jnp.arange(nwin)[:, None] * offset + jnp.arange(wlen)[None, :]
+    return trace_or_data[..., idx]
+
+
+def _circ_corr_freq(src_f: jnp.ndarray, rcv_f: jnp.ndarray, wlen: int) -> jnp.ndarray:
+    """irfft(src_f * conj(rcv_f)): circular correlation, zero lag at index 0."""
+    return jnp.fft.irfft(src_f * jnp.conj(rcv_f), n=wlen, axis=-1)
+
+
+def xcorr_pair(tr_src: jnp.ndarray, tr_rcv: jnp.ndarray, wlen: int,
+               overlap_ratio: float = 0.5) -> jnp.ndarray:
+    """Windowed circular xcorr of two traces; matches reference
+    XCORR_two_traces(tr1=tr_src, tr2=tr_rcv) (modules/utils.py:253-270):
+    average over windows then roll zero lag to index wlen//2."""
+    offset = int(wlen * (1.0 - overlap_ratio))
+    src_w = sliding_windows(tr_src, wlen, offset)       # (nwin, wlen)
+    rcv_w = sliding_windows(tr_rcv, wlen, offset)
+    sf = jnp.fft.rfft(src_w, axis=-1)
+    rf = jnp.fft.rfft(rcv_w, axis=-1)
+    c = _circ_corr_freq(sf, rf, wlen)
+    out = jnp.mean(c, axis=0)
+    return jnp.roll(out, wlen // 2, axis=-1)
+
+
+def xcorr_vshot(data: jnp.ndarray, ivs, wlen: int, overlap_ratio: float = 0.5,
+                reverse: bool = False) -> jnp.ndarray:
+    """One virtual source vs every channel; matches reference XCORR_vshot
+    (modules/utils.py:289-314).
+
+    ``data``: (nch, nt).  ``ivs``: source channel (may be traced).
+    ``reverse=True`` reproduces the reference's swapped-operand call
+    ``correlate(receiver, doubled source, 'valid')`` — numerically the
+    *index-reversed* circular correlation c[wlen-1-k].
+    Returns (nch, wlen) with zero lag at wlen//2.
+    """
+    offset = int(wlen * (1.0 - overlap_ratio))
+    wins = sliding_windows(data, wlen, offset)          # (nch, nwin, wlen)
+    wf = jnp.fft.rfft(wins, axis=-1)
+    src_f = jnp.take(wf, ivs, axis=0)                   # (nwin, nf) — traced ok
+    spec = src_f[None] * jnp.conj(wf)
+    c = jnp.fft.irfft(spec, n=wlen, axis=-1)            # (nch, nwin, wlen)
+    if reverse:
+        c = c[..., ::-1]
+    out = jnp.mean(c, axis=1)
+    return jnp.roll(out, wlen // 2, axis=-1)
+
+
+def xcorr_vshot_batch(data: jnp.ndarray, wlen: int, overlap_ratio: float = 0.5,
+                      reverse: bool = False) -> jnp.ndarray:
+    """All-pairs generalization: every channel as virtual source.
+
+    Returns (nch_src, nch_rcv, wlen).  One einsum in the frequency domain —
+    the building block of the 10k-channel ambient-noise config
+    (BASELINE.json config 4); for channel counts that exceed HBM the Pallas
+    tiled variant in ops/pallas_xcorr.py streams the (src, rcv) tile space.
+    """
+    offset = int(wlen * (1.0 - overlap_ratio))
+    wins = sliding_windows(data, wlen, offset)          # (nch, nwin, wlen)
+    wf = jnp.fft.rfft(wins, axis=-1)                    # (nch, nwin, nf)
+    spec = jnp.einsum("swf,rwf->srwf", wf, jnp.conj(wf))
+    c = jnp.fft.irfft(spec, n=wlen, axis=-1)
+    if reverse:
+        c = c[..., ::-1]
+    out = jnp.mean(c, axis=2)                           # (nsrc, nrcv, wlen)
+    return jnp.roll(out, wlen // 2, axis=-1)
+
+
+def xcorr_traj_follow(data: jnp.ndarray, t_axis: jnp.ndarray, pivot_idx: int,
+                      ch_indices: jnp.ndarray, t_at_ch: jnp.ndarray,
+                      nsamp: int, wlen: int, overlap_ratio: float = 0.5,
+                      reverse: bool = False) -> jnp.ndarray:
+    """Trajectory-following pair correlations (reference
+    apis/virtual_shot_gather.py:14-43 xcorr_two_traces_based_on_traj).
+
+    For each channel ``ch_indices[k]`` a per-channel time window of ``nsamp``
+    samples starts (forward) or ends (reverse) at the first t_axis sample
+    >= ``t_at_ch[k]``; the pivot trace is cut with the *same* per-channel
+    window, then the pair runs through the windowed circular xcorr.  The
+    data-dependent window starts become ``dynamic_slice`` + vmap — static
+    shapes, no retracing.
+
+    Returns (len(ch_indices), wlen).
+    """
+    dt_idx = jnp.searchsorted(t_axis, t_at_ch)          # first index with t >= target
+    nt = data.shape[-1]
+
+    def one(ch, ti):
+        start = jnp.where(reverse, ti - nsamp, ti)
+        start = jnp.clip(start, 0, nt - nsamp)
+        tr_ch = jax.lax.dynamic_slice(data[ch], (start,), (nsamp,))
+        tr_pv = jax.lax.dynamic_slice(data[pivot_idx], (start,), (nsamp,))
+        if reverse:
+            # reference: vs, vr = pivot, channel (virtual_shot_gather.py:37-38)
+            return xcorr_pair(tr_pv, tr_ch, wlen, overlap_ratio)
+        # reference: vs, vr = channel, pivot (virtual_shot_gather.py:39-40)
+        return xcorr_pair(tr_ch, tr_pv, wlen, overlap_ratio)
+
+    return jax.vmap(one)(ch_indices, dt_idx)

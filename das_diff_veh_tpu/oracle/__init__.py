@@ -1,0 +1,8 @@
+"""NumPy/SciPy oracle backend.
+
+Re-states the reference algorithms (NohPei/das_diff_veh) in plain NumPy so
+that (a) every JAX kernel has an executable specification to test against and
+(b) the benchmark harness can measure the TPU speedup against the same
+baseline the reference would achieve.  Written fresh from the survey of the
+reference's behavior — structured as pure functions, not a translation.
+"""

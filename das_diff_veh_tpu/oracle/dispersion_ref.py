@@ -1,0 +1,40 @@
+"""Executable specification of the reference's dispersion transform.
+
+map_fv semantics (modules/utils.py:457-475): padded 2-D FFT magnitude,
+linear-spline sampling along k = f/v (the removed scipy ``interp2d``;
+``RectBivariateSpline(kx=1, ky=1)`` is scipy's documented bug-compatible
+replacement), Savitzky-Golay (25,4) smoothing over frequency, transpose to
+(nvel, nfreq).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+from scipy.interpolate import RectBivariateSpline
+from scipy.signal import savgol_filter
+
+
+def ref_fk(data: np.ndarray, dx: float, dt: float):
+    nch, nt = data.shape
+    nf = 2 ** (1 + math.ceil(math.log2(nt)))
+    nk = 2 ** (1 + math.ceil(math.log2(nch)))
+    fk_res = np.fft.fftshift(np.fft.fft2(data, s=[nk, nf]))
+    f_axis = np.arange(-nf / 2, nf / 2) / nf / dt
+    k_axis = np.arange(-nk / 2, nk / 2) / nk / dx
+    return np.absolute(fk_res), f_axis, k_axis
+
+
+def ref_map_fv(data: np.ndarray, dx: float, dt: float, freqs: np.ndarray,
+               vels: np.ndarray, norm: bool = False,
+               sg_window: int = 25, sg_order: int = 4) -> np.ndarray:
+    if norm:
+        data = data / np.linalg.norm(data, axis=-1, keepdims=True, ord=1)
+    fk_mag, f_axis, k_axis = ref_fk(data, dx, dt)
+    spline = RectBivariateSpline(k_axis, f_axis, fk_mag, kx=1, ky=1)
+    fv = np.zeros((len(freqs), len(vels)))
+    for i, fr in enumerate(freqs):
+        fv[i] = spline(fr / vels, fr, grid=False)
+    fv = savgol_filter(fv, sg_window, sg_order, axis=0)
+    return fv.T

@@ -1,0 +1,67 @@
+"""Executable specification of the reference's windowed cross-correlation.
+
+Semantics from modules/utils.py:250-314 (XCORR_two_traces / XCORR_vshot /
+repeat1d) and apis/virtual_shot_gather.py:14-43
+(xcorr_two_traces_based_on_traj): source window circularly doubled, scipy
+``correlate(mode='valid', method='fft')`` per 50%-overlap window, stack,
+roll by wlen//2.  Used as the test oracle and the NumPy baseline in bench.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy import signal
+
+
+def _doubled(win: np.ndarray) -> np.ndarray:
+    return np.concatenate([win, win[:-1]])
+
+
+def ref_xcorr_pair(tr_src: np.ndarray, tr_rcv: np.ndarray, wlen: int,
+                   overlap_ratio: float = 0.5) -> np.ndarray:
+    offset = int(wlen * (1.0 - overlap_ratio))
+    nwin = (tr_src.size - wlen) // offset + 1
+    acc = np.zeros(wlen)
+    for w in range(nwin):
+        s = slice(w * offset, w * offset + wlen)
+        acc += signal.correlate(_doubled(tr_src[s]), tr_rcv[s], mode="valid", method="fft")
+    acc = np.roll(acc, wlen // 2)
+    return acc / nwin if nwin > 0 else acc
+
+
+def ref_xcorr_vshot(data: np.ndarray, ivs: int, wlen: int,
+                    overlap_ratio: float = 0.5, reverse: bool = False) -> np.ndarray:
+    nch, nt = data.shape
+    offset = int(wlen * (1.0 - overlap_ratio))
+    nwin = (nt - wlen) // offset + 1
+    out = np.zeros((nch, wlen))
+    for w in range(nwin):
+        s = slice(w * offset, w * offset + wlen)
+        src = _doubled(data[ivs, s])
+        for r in range(nch):
+            if reverse:
+                out[r] += signal.correlate(data[r, s], src, mode="valid", method="fft")
+            else:
+                out[r] += signal.correlate(src, data[r, s], mode="valid", method="fft")
+    if nwin == 0:
+        return out
+    return np.roll(out, wlen // 2, axis=-1) / nwin
+
+
+def ref_xcorr_traj_follow(data: np.ndarray, t_axis: np.ndarray, pivot_idx: int,
+                          ch_indices: np.ndarray, t_at_ch: np.ndarray,
+                          nsamp: int, wlen: int, overlap_ratio: float = 0.5,
+                          reverse: bool = False) -> np.ndarray:
+    out = np.zeros((len(ch_indices), wlen))
+    nt = data.shape[-1]
+    for k, (ch, t_target) in enumerate(zip(ch_indices, t_at_ch)):
+        ti = int(np.argmax(t_axis >= t_target))
+        start = ti - nsamp if reverse else ti
+        start = min(max(start, 0), nt - nsamp)
+        tr_ch = data[ch, start:start + nsamp]
+        tr_pv = data[pivot_idx, start:start + nsamp]
+        if reverse:
+            out[k] = ref_xcorr_pair(tr_pv, tr_ch, wlen, overlap_ratio)
+        else:
+            out[k] = ref_xcorr_pair(tr_ch, tr_pv, wlen, overlap_ratio)
+    return out
