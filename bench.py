@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""End-to-end benchmark: 700 m virtual-shot-gather stack + dispersion image.
+
+Reproduces the reference's headline imaging workload (BASELINE.md: a
+~60-window class stack at the 700 m pivot -> one dispersion image, the
+save_disp_imgs / bootstrap inner loop, apis/imaging_classes.py:50-85) on the
+accelerator via the batched jit pipeline, against the NumPy oracle (the
+reference semantics, measured fresh on this machine per BASELINE.md §"must
+measure").
+
+Prints ONE JSON line:
+  {"metric": "vsg_disp_700m_build", "value": <seconds>, "unit": "s",
+   "vs_baseline": <numpy_time / jax_time>}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N_WINDOWS = 60
+N_BASELINE_WINDOWS = 6          # numpy oracle timed on a subset, scaled up
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from das_diff_veh_tpu.config import DispersionConfig, GatherConfig
+    from das_diff_veh_tpu.models import vsg as V
+    from das_diff_veh_tpu.oracle.vsg_ref import ref_build_gather
+    from das_diff_veh_tpu.oracle.dispersion_ref import ref_map_fv
+    from das_diff_veh_tpu.workloads import make_gather_geometry, make_window_batch
+
+    x0, fs = 700.0, 250.0
+    gcfg = GatherConfig()
+    dcfg = DispersionConfig()
+    batch, x = make_window_batch(N_WINDOWS, x0=x0, fs=fs)
+    g = make_gather_geometry(x, x0=x0, fs=fs, cfg=gcfg)
+    offs = g.offsets(x)
+    freqs = np.arange(dcfg.freq_min, dcfg.freq_max, dcfg.freq_step)
+    vels = np.arange(dcfg.vel_min, dcfg.vel_max, dcfg.vel_step)
+
+    # --- NumPy oracle baseline (reference semantics) --------------------------
+    d_np = np.asarray(batch.data, dtype=np.float64)
+    t_np = np.asarray(batch.t, dtype=np.float64)
+    tx_np = np.asarray(batch.traj_x, dtype=np.float64)
+    tt_np = np.asarray(batch.traj_t, dtype=np.float64)
+    t0 = time.perf_counter()
+    acc = None
+    for w in range(N_BASELINE_WINDOWS):
+        xcf, _, _ = ref_build_gather(d_np[w], x, t_np[w], tx_np[w], tt_np[w],
+                                     x0, x0 - 150.0, x0 + 75.0,
+                                     wlen_s=gcfg.wlen, time_window=gcfg.time_window,
+                                     delta_t=gcfg.delta_t)
+        acc = xcf if acc is None else acc + xcf
+    acc /= N_BASELINE_WINDOWS
+    gather_time = (time.perf_counter() - t0) * (N_WINDOWS / N_BASELINE_WINDOWS)
+    sxi = int(np.abs(offs - (-150.0)).argmin())
+    exi = int(np.abs(offs - 0.0).argmin())
+    t0 = time.perf_counter()
+    ref_map_fv(acc[sxi:exi + 1], 8.16, 1.0 / fs, freqs, vels, norm=dcfg.norm)
+    np_time = gather_time + (time.perf_counter() - t0)   # image runs once per stack
+
+    # --- JAX pipeline (TPU when available) ------------------------------------
+    @jax.jit
+    def pipeline(b):
+        stack = V.stack_gathers(V.build_gather_batch(b, g, gcfg), b.valid)
+        return V.gather_disp_image(stack, offs, g.dt, 8.16, dcfg, -150.0, 0.0)
+
+    img = jax.block_until_ready(pipeline(batch))        # compile
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        img = jax.block_until_ready(pipeline(batch))
+    jax_time = (time.perf_counter() - t0) / reps
+
+    assert bool(jnp.isfinite(img).all()), "benchmark produced non-finite image"
+    print(json.dumps({
+        "metric": "vsg_disp_700m_build",
+        "value": round(jax_time, 5),
+        "unit": "s",
+        "vs_baseline": round(np_time / jax_time, 2),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

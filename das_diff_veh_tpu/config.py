@@ -100,10 +100,17 @@ class WindowConfig:
 
 @dataclass(frozen=True)
 class MuteConfig:
-    """Trajectory-aware muting (reference: apis/data_classes.py:49-104)."""
+    """Trajectory-aware muting (reference: apis/data_classes.py:49-104).
+
+    ``offset=300`` is the aggregation-path default (reference
+    apis/imaging_classes.py:96 ``mute_offset=300``); the SurfaceWaveWindow
+    method defaults are offset=200 with alpha=0.3 (single-sided, :49) /
+    alpha=0.05 (double-sided, :74).
+    """
 
     offset: float = 300.0             # taper width [m]
-    alpha: float = 0.3                # tukey shape
+    alpha: float = 0.3                # tukey shape, single-sided mute
+    alpha_double: float = 0.05        # tukey shape, double-sided mute
     delta_x: float = 20.0             # asymmetric center shift [m]
     time_alpha: float = 0.3
 

@@ -1,0 +1,2 @@
+"""Domain models: window selection/muting, virtual-shot gathers, tracking,
+dispersion imaging, and the differentiable Rayleigh forward model."""

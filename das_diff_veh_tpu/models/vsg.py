@@ -1,0 +1,180 @@
+"""Virtual-shot-gather interferometry — the centerpiece of the framework.
+
+Trajectory-aware seismic interferometry turning each per-vehicle window into
+a virtual shot gather at a pivot channel (reference
+apis/virtual_shot_gather.py:111-270):
+
+- channels *behind* the vehicle correlate against the pivot over one fixed
+  time window anchored ``delta_t`` after the vehicle's pivot arrival
+  (reference :172 XCORR_vshot);
+- channels *between pivot and vehicle* use per-channel windows that follow
+  the trajectory (reference :14-43,174);
+- the mirrored "other side" runs time-reversed windows *ahead* of the
+  vehicle (reference :145-161) and is averaged in where nonzero (:189-192).
+
+TPU-first design: all channel geometry is static (resolved host-side into a
+:class:`VsgGeometry`), all data-dependent time offsets become masked windowed
+FFT correlations (ops.xcorr), and the whole gather is one jit-able pure
+function, vmapped over the window batch.  Stacking replaces the reference's
+``__add__/__truediv__`` object algebra (:195-210) with a masked mean.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from das_diff_veh_tpu.config import DispersionConfig, GatherConfig
+from das_diff_veh_tpu.core.section import WindowBatch
+from das_diff_veh_tpu.ops.interp import masked_interp
+from das_diff_veh_tpu.ops import xcorr as xc
+from das_diff_veh_tpu.ops.dispersion import fv_map_fk
+
+
+@dataclass(frozen=True)
+class VsgGeometry:
+    """Static channel/time geometry of one gather configuration.
+
+    Mirrors preprocessing_window's index math (reference
+    apis/virtual_shot_gather.py:111-126) but resolved once on the host: the
+    window batch shares its x/t axes, so these are compile-time constants.
+    """
+
+    start_x_idx: int       # argmax(x >= start_x)            (reference :120)
+    end_x_idx: int         # argmin(|x - end_x|)             (reference :121)
+    pivot_idx: int         # argmax(x >= pivot)              (reference :116)
+    pivot_x: float         # the *requested* pivot coordinate — the reference
+                           # interpolates the pivot arrival at this value, not
+                           # at the snapped channel position (reference :117)
+    nsamp: int             # int(time_window_to_xcorr // dt) (reference :123)
+    wlen: int              # int(wlen / dt)  correlation window [samples]
+    dt: float
+
+    @property
+    def nch_out(self) -> int:
+        return self.end_x_idx - self.start_x_idx
+
+    @classmethod
+    def build(cls, x_axis: np.ndarray, dt: float, pivot: float,
+              start_x: float, end_x: float, cfg: GatherConfig) -> "VsgGeometry":
+        x = np.asarray(x_axis)
+        return cls(
+            start_x_idx=int(np.argmax(x >= start_x)),
+            end_x_idx=int(np.abs(x - end_x).argmin()),
+            pivot_idx=int(np.argmax(x >= pivot)),
+            pivot_x=float(pivot),
+            nsamp=int(cfg.time_window // dt),
+            wlen=int(cfg.wlen / dt),
+            dt=float(dt),
+        )
+
+    def offsets(self, x_axis: np.ndarray) -> np.ndarray:
+        """Output x axis: offsets re-zeroed at the pivot (reference :130)."""
+        x = np.asarray(x_axis)
+        return x[self.start_x_idx:self.end_x_idx] - x[self.pivot_idx]
+
+    def lags(self) -> np.ndarray:
+        """Output lag-time axis, zero lag centered (reference :131-132)."""
+        return (np.arange(self.wlen) - self.wlen // 2) * self.dt
+
+
+def _postprocess(xcf: jnp.ndarray, g: VsgGeometry, norm: bool, norm_amp: bool,
+                 reverse: bool) -> jnp.ndarray:
+    """post_processing_XCF (reference apis/virtual_shot_gather.py:129-142):
+    per-trace L2 norm, amplitude norm by the pivot trace's max, and a lag-axis
+    flip on the main side.  Zero rows divide by 1 instead of 0/0 (the
+    reference would emit NaN rows; masked stacking makes that unnecessary)."""
+    if norm:
+        rn = jnp.linalg.norm(xcf, axis=-1, keepdims=True)
+        xcf = xcf / jnp.where(rn > 0, rn, 1.0)
+    if norm_amp:
+        amp = jnp.max(xcf[g.pivot_idx - g.start_x_idx])
+        xcf = xcf / jnp.where(jnp.abs(amp) > 0, amp, 1.0)
+    if not reverse:
+        xcf = xcf[:, ::-1]
+    return xcf
+
+
+def build_gather(data: jnp.ndarray, t_axis: jnp.ndarray, x_axis: jnp.ndarray,
+                 traj_x: jnp.ndarray, traj_t: jnp.ndarray,
+                 traj_valid: jnp.ndarray, g: VsgGeometry,
+                 cfg: GatherConfig = GatherConfig()) -> jnp.ndarray:
+    """One window -> one virtual shot gather (nch_out, wlen).
+
+    Mirrors construct_shot_gather (+ the other-side merge when
+    ``cfg.include_other_side``) — reference apis/virtual_shot_gather.py:165-192.
+    Pure function of arrays + static geometry: jit/vmap/shard freely.
+    """
+    arrival = lambda xq: masked_interp(xq, traj_x, traj_t, traj_valid)
+    gn = jnp.linalg.norm(data)                           # global L2 (reference :125)
+    d = data / jnp.where(gn > 0, gn, 1.0)                # all-zero (padded) windows stay 0
+    x = jnp.asarray(x_axis)
+
+    # ---- main side (behind the vehicle) --------------------------------------
+    pivot_t = arrival(jnp.asarray(g.pivot_x)) + cfg.delta_t
+    pivot_t_idx = jnp.argmax(t_axis >= pivot_t)
+    near = xc.xcorr_vshot_at(d[g.start_x_idx:g.pivot_idx + 1],
+                             g.pivot_idx - g.start_x_idx, pivot_t_idx,
+                             g.nsamp, g.wlen, cfg.overlap_ratio)
+    far_ch = jnp.arange(g.pivot_idx + 1, g.end_x_idx)
+    far_t = arrival(x[far_ch]) + cfg.delta_t
+    far = xc.xcorr_traj_follow(d, t_axis, g.pivot_idx, far_ch, far_t,
+                               g.nsamp, g.wlen, cfg.overlap_ratio)
+    main = _postprocess(jnp.concatenate([near, far], axis=0), g,
+                        cfg.norm, cfg.norm_amp, reverse=False)
+    if not cfg.include_other_side:
+        return main
+
+    # ---- other side (ahead of the vehicle, time-reversed windows) ------------
+    pivot_t2 = arrival(jnp.asarray(g.pivot_x)) - cfg.delta_t
+    pivot_t2_idx = jnp.argmax(t_axis >= pivot_t2)
+    right = xc.xcorr_vshot_at(d[g.pivot_idx:g.end_x_idx], 0, pivot_t2_idx,
+                              g.nsamp, g.wlen, cfg.overlap_ratio,
+                              reverse=True, backward=True)
+    left_ch = jnp.arange(g.start_x_idx, g.pivot_idx)
+    left_t = arrival(x[left_ch]) - cfg.delta_t
+    left = xc.xcorr_traj_follow(d, t_axis, g.pivot_idx, left_ch, left_t,
+                                g.nsamp, g.wlen, cfg.overlap_ratio, reverse=True)
+    other = _postprocess(jnp.concatenate([left, right], axis=0), g,
+                         cfg.norm, cfg.norm_amp, reverse=True)
+
+    # average in other-side rows where they are nonzero (reference :189-192)
+    has_other = jnp.linalg.norm(other, axis=-1, keepdims=True) > 0
+    return jnp.where(has_other, 0.5 * (main + other), main)
+
+
+def build_gather_batch(batch: WindowBatch, g: VsgGeometry,
+                       cfg: GatherConfig = GatherConfig()) -> jnp.ndarray:
+    """vmap of :func:`build_gather` over a window batch: (max_windows, nch_out, wlen)."""
+    traj_valid = jnp.isfinite(batch.traj_t)
+    fn = lambda d, t, tx, tt, tv: build_gather(d, t, batch.x, tx, tt, tv, g, cfg)
+    return jax.vmap(fn)(batch.data, batch.t, batch.traj_x, batch.traj_t, traj_valid)
+
+
+def stack_gathers(gathers: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Masked mean over the window axis — replaces the reference's
+    sum(images)/len (apis/imaging_classes.py:106-107).  ``where``-masked so a
+    NaN in an invalid slot cannot leak through (NaN*0 == NaN)."""
+    mask = valid.reshape(valid.shape + (1,) * (gathers.ndim - 1))
+    num = jnp.sum(jnp.where(mask, gathers, 0.0), axis=0)
+    return num / jnp.maximum(jnp.sum(valid.astype(gathers.dtype)), 1.0)
+
+
+def gather_disp_image(xcf: jnp.ndarray, offsets: np.ndarray, dt: float,
+                      dx: float, cfg: DispersionConfig = DispersionConfig(),
+                      start_x: float | None = None,
+                      end_x: float | None = None) -> jnp.ndarray:
+    """Dispersion image of (a stack of) gathers over an offset sub-range
+    (reference VirtualShotGather.compute_disp_image,
+    apis/virtual_shot_gather.py:247-258 — which hardcodes dx=8.16; here the
+    interrogator's dx is a parameter).  Returns (nvel, nfreq)."""
+    offsets = np.asarray(offsets)
+    sxi = int(np.abs(offsets - (start_x if start_x is not None else offsets[0])).argmin())
+    exi = int(np.abs(offsets - (end_x if end_x is not None else offsets[-1])).argmin())
+    freqs = jnp.arange(cfg.freq_min, cfg.freq_max, cfg.freq_step)
+    vels = jnp.arange(cfg.vel_min, cfg.vel_max, cfg.vel_step)
+    return fv_map_fk(xcf[..., sxi:exi + 1, :], dx, dt, freqs, vels,
+                     norm=cfg.norm, sg_window=cfg.sg_window, sg_order=cfg.sg_order)

@@ -1,0 +1,150 @@
+"""Surface-wave window selection and trajectory-aware muting.
+
+TPU-first re-design of the reference's SurfaceWaveSelector/SurfaceWaveWindow
+(apis/data_classes.py:12-256): instead of a Python list of deep-copied window
+objects, selection produces one static-shape :class:`WindowBatch` tensor with
+a validity mask — every vehicle slot yields a (nx, nt_win) slice via
+``dynamic_slice`` whether accepted or not, and rejected slots are masked.
+Muting builds multiplicative (nx, nt) Tukey masks in one vectorized gather
+instead of the reference's per-time-sample Python loop
+(apis/data_classes.py:60-70).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from das_diff_veh_tpu.config import MuteConfig, WindowConfig
+from das_diff_veh_tpu.core.section import VehicleTracks, WindowBatch
+from das_diff_veh_tpu.ops.filters import tukey_window
+from das_diff_veh_tpu.ops.interp import masked_interp
+
+
+def traj_mute_mask(x_axis: jnp.ndarray, t_axis: jnp.ndarray,
+                   traj_x: jnp.ndarray, traj_t: jnp.ndarray,
+                   traj_valid: jnp.ndarray, dx: float,
+                   offset: float = 200.0, alpha: float = 0.3,
+                   delta_x: float = 20.0,
+                   double_sided: bool = False) -> jnp.ndarray:
+    """(nx, nt) multiplicative mute mask following the vehicle trajectory.
+
+    Per time sample the mask is an ``int(offset/dx)``-sample Tukey window
+    whose center tracks the interpolated car position — off-center by
+    ``-offset/2 + delta_x`` in the single-sided variant (reference
+    apis/data_classes.py:62) or centered in the double-sided one (:88); zero
+    outside the taper.  The reference's ``argmax(x_axis > center)`` center
+    pick (:63) is kept bit-for-bit, including its all-False -> 0 behavior.
+    """
+    n_samp = int(offset / dx)
+    w = tukey_window(n_samp, alpha)
+    car_x = masked_interp(t_axis, traj_t, traj_x, traj_valid)     # (nt,)
+    center = car_x if double_sided else car_x - offset / 2.0 + delta_x
+    center_idx = jnp.argmax(x_axis[:, None] > center[None, :], axis=0)   # (nt,)
+    j = jnp.arange(x_axis.shape[0])[:, None] - (center_idx[None, :] - n_samp // 2)
+    inside = (j >= 0) & (j < n_samp)
+    return jnp.where(inside, w[jnp.clip(j, 0, n_samp - 1)], 0.0)
+
+
+def mute_along_traj(data: jnp.ndarray, x_axis: jnp.ndarray, t_axis: jnp.ndarray,
+                    traj_x: jnp.ndarray, traj_t: jnp.ndarray,
+                    traj_valid: jnp.ndarray, dx: float,
+                    cfg: MuteConfig = MuteConfig(),
+                    double_sided: bool = False) -> jnp.ndarray:
+    """Apply the trajectory mute (reference apis/data_classes.py:49-98)."""
+    alpha = cfg.alpha_double if double_sided else cfg.alpha
+    mask = traj_mute_mask(x_axis, t_axis, traj_x, traj_t, traj_valid, dx,
+                          offset=cfg.offset, alpha=alpha,
+                          delta_x=cfg.delta_x, double_sided=double_sided)
+    return data * mask
+
+
+def mute_along_time(data: jnp.ndarray, alpha: float = 0.3) -> jnp.ndarray:
+    """Temporal Tukey mute (reference apis/data_classes.py:100-104)."""
+    return data * tukey_window(data.shape[-1], alpha)[None, :]
+
+
+def select_windows(data: jnp.ndarray, x: np.ndarray, t: np.ndarray,
+                   tracks: VehicleTracks, x0: float,
+                   cfg: WindowConfig = WindowConfig()) -> WindowBatch:
+    """Cut one static-shape window batch around each tracked vehicle's arrival
+    at pivot ``x0`` (reference SurfaceWaveSelector.locate_windows,
+    apis/data_classes.py:170-223).
+
+    Accept/reject logic (as validity masks instead of ``continue``):
+
+    - the vehicle state at ``x0`` must be finite;
+    - *isolation*: the arrival-time gap at ``x0`` to the list-adjacent
+      vehicles (detection order = arrival order) must be >=
+      ``temporal_spacing`` (reference :180-193); neighbors without a finite
+      arrival at ``x0`` (padding slots / undetected-at-pivot) are skipped;
+    - *boundary*: the +-wlen/2 cut must fit inside the record (:199-200).
+
+    ``x``/``t`` must be concrete (host) arrays — static slice geometry is
+    resolved in numpy; the per-vehicle time cuts are vmapped dynamic slices.
+    """
+    # sync in-flight device work first: the axon TPU tunnel cannot service a
+    # device->host read (the np.asarray geometry below) while compute is in
+    # flight, and the failure poisons the stream
+    jax.block_until_ready(data)
+    x = np.asarray(x)
+    t = np.asarray(t)
+    dt = float(t[1] - t[0])
+    win_nsamp = int(cfg.wlen_sw / dt)
+    spacing = cfg.temporal_spacing if cfg.temporal_spacing else cfg.wlen_sw
+
+    start_x = x0 - cfg.length_sw * cfg.spatial_ratio
+    end_x = start_x + cfg.length_sw
+    start_x_idx = int(np.abs(start_x - x).argmin())
+    end_x_idx = int(np.abs(end_x - x).argmin())          # exclusive (reference :212)
+    nx = end_x_idx - start_x_idx
+
+    x_track = np.asarray(tracks.x)
+    t_track = np.asarray(tracks.t)
+    x0_track_idx = int(np.abs(x_track - x0).argmin())
+    dt_track = float(t_track[1] - t_track[0])
+    t_track0 = float(t_track[0])
+    nt = t.shape[0]
+
+    t_idx = tracks.t_idx                                  # (nveh, n_track_ch)
+    raw = t_idx[:, x0_track_idx]                          # float sample index at x0
+    finite = jnp.isfinite(raw)
+    # reference: int(v[x0_idx]) truncation, then t_axis_tracking lookup (:177,195)
+    t0_i = jnp.clip(jnp.floor(jnp.where(finite, raw, 0.0)), 0, t_track.shape[0] - 1)
+    t0 = t_track0 + t0_i * dt_track
+
+    valid = tracks.valid & finite
+
+    # isolation against the list-adjacent vehicles (reference :180-193),
+    # skipping neighbors without a finite arrival at x0
+    t0_next = jnp.concatenate([t0[1:], jnp.asarray([0.0])])
+    next_finite = jnp.concatenate([finite[1:], jnp.asarray([False])])
+    t0_prev = jnp.concatenate([jnp.asarray([0.0]), t0[:-1]])
+    prev_finite = jnp.concatenate([jnp.asarray([False]), finite[:-1]])
+    reject_next = next_finite & ((t0_next - t0) < spacing)
+    gap_prev = t0 - t0_prev
+    reject_prev = prev_finite & (gap_prev >= 0) & (gap_prev < spacing)
+    valid = valid & ~reject_next & ~reject_prev
+
+    # boundary test on the surface-wave grid (reference :196-200)
+    t0_sw_idx = jnp.clip(jnp.round((t0 - t[0]) / dt).astype(jnp.int32), 0, nt - 1)
+    valid = valid & (t0_sw_idx >= win_nsamp // 2) & (t0_sw_idx + win_nsamp // 2 <= nt)
+
+    start_t_idx = jnp.clip(t0_sw_idx - win_nsamp // 2, 0, nt - win_nsamp)
+    sub = data[start_x_idx:end_x_idx]
+
+    def cut(st):
+        return jax.lax.dynamic_slice(sub, (jnp.zeros((), st.dtype), st),
+                                     (nx, win_nsamp))
+
+    win_data = jax.vmap(cut)(start_t_idx)                 # (nveh, nx, win_nsamp)
+    win_t = t[0] + (start_t_idx[:, None] + jnp.arange(win_nsamp)[None, :]) * dt
+
+    # trajectory in physical coordinates, floor-quantized to the tracking grid
+    # exactly like _preprocess_veh_state (reference apis/data_classes.py:34-39)
+    traj_t = t_track0 + jnp.floor(t_idx) * dt_track       # NaN-preserving
+    traj_x = jnp.broadcast_to(jnp.asarray(x_track), t_idx.shape)
+
+    return WindowBatch(data=win_data, x=jnp.asarray(x[start_x_idx:end_x_idx]),
+                       t=win_t, traj_x=traj_x, traj_t=traj_t, valid=valid)

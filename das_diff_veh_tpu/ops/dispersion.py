@@ -6,9 +6,10 @@ Two paths:
   (modules/utils.py:457-475): 2-D FFT magnitude (``fk``, modules/
   utils.py:236-248), bilinear sampling along k = f/v, Savitzky-Golay (25,4)
   smoothing over frequency.  The reference samples with the long-removed
-  ``scipy.interpolate.interp2d`` (linear spline); our bilinear gather keeps
-  the *unclamped* fractional coordinate in the edge cell, which reproduces
-  the linear-spline extrapolation outside the f-k grid bug-for-bug.
+  ``scipy.interpolate.interp2d`` (linear spline); our bilinear gather
+  *clamps* out-of-domain queries to the boundary value, which is what
+  FITPACK's degree-1 spline does for the k = f/v samples beyond spatial
+  Nyquist (verified empirically against RectBivariateSpline(kx=ky=1)).
 
 - ``fv_map_phase_shift``: the frequency-domain slant stack
   P(v, f) = |Σ_x U(x, f) e^{i 2π f x / v}| (Park et al. phase-shift method)

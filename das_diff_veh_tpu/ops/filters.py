@@ -26,13 +26,17 @@ def _butter_sos(order: int, wlo: float, whi: float) -> np.ndarray:
     return signal.butter(order, [wlo, whi], btype="band", output="sos")
 
 
-def _sos_gain(sos: np.ndarray, freqs: jnp.ndarray, fs: float) -> jnp.ndarray:
-    """|H(f)|² of an SOS cascade evaluated at ``freqs`` [Hz]."""
-    z = jnp.exp(-2j * jnp.pi * freqs / fs)
-    h = jnp.ones_like(z)
+def _sos_gain(sos: np.ndarray, freqs: np.ndarray, fs: float) -> np.ndarray:
+    """|H(f)|² of an SOS cascade evaluated at ``freqs`` [Hz].
+
+    Pure host-side numpy: the gain is a static constant of the filter design,
+    and complex128 scalar math must never reach the TPU (unsupported there —
+    an eager complex op also wedges the axon tunnel's transfer stream)."""
+    z = np.exp(-2j * np.pi * np.asarray(freqs) / fs)
+    h = np.ones_like(z)
     for b0, b1, b2, a0, a1, a2 in sos:
         h = h * (b0 + b1 * z + b2 * z * z) / (a0 + a1 * z + a2 * z * z)
-    return jnp.abs(h) ** 2
+    return np.abs(h) ** 2
 
 
 def _fft_zero_phase(data: jnp.ndarray, fs: float, flo: float, fhi: float,
@@ -47,8 +51,8 @@ def _fft_zero_phase(data: jnp.ndarray, fs: float, flo: float, fhi: float,
     ext = jnp.concatenate([head, data, tail], axis=-1)
     nfft = ext.shape[-1]
     sos = _butter_sos(order, 2.0 * flo / fs, 2.0 * fhi / fs)
-    freqs = jnp.fft.rfftfreq(nfft, d=1.0 / fs)
-    gain = _sos_gain(sos, freqs, fs).astype(data.dtype)
+    freqs = np.fft.rfftfreq(nfft, d=1.0 / fs)
+    gain = jnp.asarray(_sos_gain(sos, freqs, fs), dtype=data.dtype)
     spec = jnp.fft.rfft(ext, axis=-1) * gain
     out = jnp.fft.irfft(spec, n=nfft, axis=-1)[..., pad:pad + n].astype(data.dtype)
     return jnp.moveaxis(out, -1, axis)

@@ -1,0 +1,43 @@
+"""Masked 1-D linear interpolation with end-segment extrapolation.
+
+The reference interpolates vehicle trajectories with
+``scipy.interpolate.interp1d(..., fill_value='extrapolate')`` (reference
+apis/virtual_shot_gather.py:112, apis/data_classes.py:55) and its ``extrap1d``
+wrapper (modules/utils.py:54-69) — both are piecewise-linear with linear
+extrapolation from the end segments.  Trajectories here are NaN-padded to
+static shapes, so the interpolant must ignore invalid knots under jit:
+invalid abscissae are pushed to +inf, a sort compacts the valid knots to the
+front, and queries interpolate/extrapolate on the valid run only.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_BIG = 1e30
+
+
+def masked_interp(xq: jnp.ndarray, xs: jnp.ndarray, ys: jnp.ndarray,
+                  valid: jnp.ndarray) -> jnp.ndarray:
+    """Piecewise-linear interpolation of ``(xs, ys)`` knots at ``xq``.
+
+    ``valid`` masks live knots; valid ``xs`` must be strictly increasing.
+    Queries outside the valid span extrapolate linearly from the first/last
+    valid segment (scipy ``fill_value='extrapolate'`` behavior).  With a
+    single valid knot the query returns its ``y``; with none, zeros
+    (callers are expected to mask such trajectories out entirely).
+    """
+    xs = jnp.where(valid, xs, _BIG)
+    order = jnp.argsort(xs)
+    xs_s = xs[order]
+    ys_s = jnp.where(valid, ys, 0.0)[order]
+    n_valid = jnp.sum(valid)
+    last_seg = jnp.maximum(n_valid - 2, 0)         # index of the last valid segment start
+    i = jnp.searchsorted(xs_s, xq, side="right") - 1
+    i = jnp.clip(i, 0, last_seg)
+    x0 = xs_s[i]
+    x1 = xs_s[i + 1]
+    dx = x1 - x0
+    w = (xq - x0) / jnp.where((dx > 0) & (dx < _BIG / 2), dx, 1.0)
+    w = jnp.where((n_valid >= 2) & (x1 < _BIG / 2), w, 0.0)
+    return ys_s[i] + w * (ys_s[i + 1] - ys_s[i])

@@ -93,6 +93,75 @@ def xcorr_vshot_batch(data: jnp.ndarray, wlen: int, overlap_ratio: float = 0.5,
     return jnp.roll(out, wlen // 2, axis=-1)
 
 
+def _masked_window_specs(data: jnp.ndarray, start, nsamp: int, wlen: int,
+                         offset: int, backward: bool):
+    """rfft of windows cut at *absolute* sample positions, with reference-parity
+    validity masks.
+
+    ``backward=False``: the logical slice is ``[start, start+nsamp)`` and is
+    *truncated at the record end* like a numpy slice — window w (at
+    start + w*offset) is valid iff it fits inside the truncated span.
+    ``backward=True``: the logical slice is ``[start-nsamp, start)`` and is
+    *empty whenever start < nsamp* — numpy's negative-start slice yields an
+    empty array there (reference apis/virtual_shot_gather.py:31,152), so every
+    window is invalid.  Assumes nsamp <= nt.
+
+    Returns ``(win_f (..., nwin, nf), valid (nwin,), n_eff scalar)``.
+    """
+    nt = data.shape[-1]
+    nwin = (nsamp - wlen) // offset + 1
+    w = jnp.arange(nwin)
+    if backward:
+        s0 = start - nsamp
+        avail = jnp.where(s0 >= 0, nsamp, 0)
+    else:
+        s0 = start
+        avail = jnp.clip(nt - start, 0, nsamp)
+    valid = (w * offset + wlen) <= avail                # (nwin,)
+    starts = jnp.clip(s0 + w * offset, 0, nt - wlen)
+    idx = starts[:, None] + jnp.arange(wlen)[None, :]   # (nwin, wlen)
+    wins = data[..., idx]                               # (..., nwin, wlen)
+    return jnp.fft.rfft(wins, axis=-1), valid, jnp.sum(valid)
+
+
+def xcorr_pair_at(tr_src: jnp.ndarray, tr_rcv: jnp.ndarray, start, nsamp: int,
+                  wlen: int, overlap_ratio: float = 0.5,
+                  backward: bool = False) -> jnp.ndarray:
+    """Windowed circular xcorr of the data-dependent slice
+    ``[start, start+nsamp)`` (or ``[start-nsamp, start)`` with
+    ``backward=True``) of two traces — the building block of the
+    trajectory-following gather (reference apis/virtual_shot_gather.py:31-41).
+
+    Static shapes: the reference's numpy truncation/empty-slice behavior is
+    reproduced with per-window validity masks (zero output when no window
+    fits, matching XCORR_two_traces' ``nwin > 0`` guard, modules/utils.py:267).
+    """
+    offset = int(wlen * (1.0 - overlap_ratio))
+    sf, valid, n_eff = _masked_window_specs(tr_src, start, nsamp, wlen, offset, backward)
+    rf, _, _ = _masked_window_specs(tr_rcv, start, nsamp, wlen, offset, backward)
+    c = _circ_corr_freq(sf, rf, wlen)                   # (nwin, wlen)
+    out = jnp.sum(jnp.where(valid[:, None], c, 0.0), axis=0) / jnp.maximum(n_eff, 1)
+    return jnp.roll(out, wlen // 2, axis=-1)
+
+
+def xcorr_vshot_at(data: jnp.ndarray, ivs, start, nsamp: int, wlen: int,
+                   overlap_ratio: float = 0.5, reverse: bool = False,
+                   backward: bool = False) -> jnp.ndarray:
+    """``xcorr_vshot`` on the data-dependent time slice ``[start, start+nsamp)``
+    (``backward=True``: ``[start-nsamp, start)``) of (nch, nt) data — the
+    one-sided gather kernels of the reference
+    (apis/virtual_shot_gather.py:152-153,172).  Same masked-window parity
+    semantics as :func:`xcorr_pair_at`.  Returns (nch, wlen)."""
+    offset = int(wlen * (1.0 - overlap_ratio))
+    wf, valid, n_eff = _masked_window_specs(data, start, nsamp, wlen, offset, backward)
+    src_f = jnp.take(wf, ivs, axis=0)                   # (nwin, nf)
+    c = _circ_corr_freq(src_f[None], wf, wlen)          # (nch, nwin, wlen)
+    if reverse:
+        c = c[..., ::-1]
+    out = jnp.sum(jnp.where(valid[None, :, None], c, 0.0), axis=1) / jnp.maximum(n_eff, 1)
+    return jnp.roll(out, wlen // 2, axis=-1)
+
+
 def xcorr_traj_follow(data: jnp.ndarray, t_axis: jnp.ndarray, pivot_idx: int,
                       ch_indices: jnp.ndarray, t_at_ch: jnp.ndarray,
                       nsamp: int, wlen: int, overlap_ratio: float = 0.5,
@@ -101,26 +170,23 @@ def xcorr_traj_follow(data: jnp.ndarray, t_axis: jnp.ndarray, pivot_idx: int,
     apis/virtual_shot_gather.py:14-43 xcorr_two_traces_based_on_traj).
 
     For each channel ``ch_indices[k]`` a per-channel time window of ``nsamp``
-    samples starts (forward) or ends (reverse) at the first t_axis sample
-    >= ``t_at_ch[k]``; the pivot trace is cut with the *same* per-channel
-    window, then the pair runs through the windowed circular xcorr.  The
-    data-dependent window starts become ``dynamic_slice`` + vmap — static
-    shapes, no retracing.
-
-    Returns (len(ch_indices), wlen).
+    samples starts (forward) or ends (reverse) at
+    ``argmax(t_axis >= t_at_ch[k])``; the pivot trace is cut with the *same*
+    per-channel window, then the pair runs through the masked windowed
+    circular xcorr (numpy truncation/empty-slice parity, see
+    :func:`xcorr_pair_at`).  Returns (len(ch_indices), wlen).
     """
-    dt_idx = jnp.searchsorted(t_axis, t_at_ch)          # first index with t >= target
-    nt = data.shape[-1]
+    dt_idx = jnp.argmax(t_axis[None, :] >= t_at_ch[:, None], axis=-1)
 
     def one(ch, ti):
-        start = jnp.where(reverse, ti - nsamp, ti)
-        start = jnp.clip(start, 0, nt - nsamp)
-        tr_ch = jax.lax.dynamic_slice(data[ch], (start,), (nsamp,))
-        tr_pv = jax.lax.dynamic_slice(data[pivot_idx], (start,), (nsamp,))
+        tr_ch = data[ch]
+        tr_pv = data[pivot_idx]
         if reverse:
             # reference: vs, vr = pivot, channel (virtual_shot_gather.py:37-38)
-            return xcorr_pair(tr_pv, tr_ch, wlen, overlap_ratio)
+            return xcorr_pair_at(tr_pv, tr_ch, ti, nsamp, wlen, overlap_ratio,
+                                 backward=True)
         # reference: vs, vr = channel, pivot (virtual_shot_gather.py:39-40)
-        return xcorr_pair(tr_ch, tr_pv, wlen, overlap_ratio)
+        return xcorr_pair_at(tr_ch, tr_pv, ti, nsamp, wlen, overlap_ratio,
+                             backward=False)
 
     return jax.vmap(one)(ch_indices, dt_idx)

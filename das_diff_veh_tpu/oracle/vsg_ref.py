@@ -1,0 +1,90 @@
+"""Executable numpy specification of the virtual-shot-gather construction.
+
+Semantics from apis/virtual_shot_gather.py:111-192 (preprocessing_window /
+construct_shot_gather / construct_shot_gather_other_side /
+post_processing_XCF / the other-side merge in VirtualShotGather.__init__),
+on raw arrays instead of window objects.  Parity oracle + NumPy baseline for
+das_diff_veh_tpu.models.vsg.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from das_diff_veh_tpu.oracle.windows_ref import lin_interp_extrap
+from das_diff_veh_tpu.oracle.xcorr_ref import ref_xcorr_traj_follow, ref_xcorr_vshot
+
+
+def _traj_time_at(traj_x: np.ndarray, traj_t: np.ndarray, xq) -> np.ndarray:
+    m = np.isfinite(traj_t) & np.isfinite(traj_x)
+    return lin_interp_extrap(xq, traj_x[m], traj_t[m])
+
+
+def _traj_rows(data, t_axis, pivot_idx, traj_x, traj_t, x_axis, ch_lo, ch_hi,
+               nsamp, wlen, delta_t, reverse):
+    """Per-channel trajectory-following rows (reference :14-43)."""
+    ch = np.arange(ch_lo, ch_hi)
+    t_at_ch = _traj_time_at(traj_x, traj_t, x_axis[ch])
+    t_at_ch = t_at_ch - delta_t if reverse else t_at_ch + delta_t
+    return ref_xcorr_traj_follow(data, t_axis, pivot_idx, ch, t_at_ch,
+                                 nsamp, wlen, reverse=reverse)
+
+
+def _post(xcf, pivot_idx, start_x_idx, norm, norm_amp, reverse):
+    """post_processing_XCF (reference :129-142), with 0-row guard."""
+    if norm:
+        rn = np.linalg.norm(xcf, axis=-1, keepdims=True)
+        xcf = xcf / np.where(rn > 0, rn, 1.0)
+    if norm_amp:
+        amp = np.max(xcf[pivot_idx - start_x_idx])
+        if abs(amp) > 0:
+            xcf = xcf / amp
+    if not reverse:
+        xcf = xcf[:, ::-1]
+    return xcf
+
+
+def ref_build_gather(data: np.ndarray, x_axis: np.ndarray, t_axis: np.ndarray,
+                     traj_x: np.ndarray, traj_t: np.ndarray, pivot: float,
+                     start_x: float, end_x: float, wlen_s: float = 2.0,
+                     time_window: float = 4.0, delta_t: float = 1.0,
+                     norm: bool = True, norm_amp: bool = True,
+                     include_other_side: bool = True):
+    """One window -> (XCF (nch_out, wlen), offsets, lags)."""
+    dt = t_axis[1] - t_axis[0]
+    pivot_idx = int(np.argmax(x_axis >= pivot))
+    sxi = int(np.argmax(x_axis >= start_x))
+    exi = int(np.abs(x_axis - end_x).argmin())
+    nsamp = int(time_window // dt)
+    wlen = int(wlen_s / dt)
+    d = data / np.linalg.norm(data)
+
+    # main side
+    pt = _traj_time_at(traj_x, traj_t, pivot)[0] + delta_t
+    pti = int(np.argmax(t_axis >= pt))
+    near = ref_xcorr_vshot(d[sxi:pivot_idx + 1, pti:pti + nsamp],
+                           pivot_idx - sxi, wlen)
+    far = _traj_rows(d, t_axis, pivot_idx, traj_x, traj_t, x_axis,
+                     pivot_idx + 1, exi, nsamp, wlen, delta_t, reverse=False)
+    main = _post(np.concatenate([near, far], axis=0), pivot_idx, sxi,
+                 norm, norm_amp, reverse=False)
+
+    if include_other_side:
+        pt2 = _traj_time_at(traj_x, traj_t, pivot)[0] - delta_t
+        pti2 = int(np.argmax(t_axis >= pt2))
+        if pti2 - nsamp < 0:
+            right = np.zeros((exi - pivot_idx, wlen))
+        else:
+            right = ref_xcorr_vshot(d[pivot_idx:exi, pti2 - nsamp:pti2], 0,
+                                    wlen, reverse=True)
+        left = _traj_rows(d, t_axis, pivot_idx, traj_x, traj_t, x_axis,
+                          sxi, pivot_idx, nsamp, wlen, delta_t, reverse=True)
+        other = _post(np.concatenate([left, right], axis=0), pivot_idx, sxi,
+                      norm, norm_amp, reverse=True)
+        stack = np.linalg.norm(other, axis=-1) > 0
+        main = main.copy()
+        main[stack] = 0.5 * (main[stack] + other[stack])
+
+    offsets = x_axis[sxi:exi] - x_axis[pivot_idx]
+    lags = (np.arange(wlen) - wlen // 2) * dt
+    return main, offsets, lags

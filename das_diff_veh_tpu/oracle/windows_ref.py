@@ -1,0 +1,87 @@
+"""Executable numpy specification of window selection + trajectory muting.
+
+Semantics from apis/data_classes.py: the per-time-sample Tukey mute loop
+(:49-104) and SurfaceWaveSelector.locate_windows (:170-223).  Used as the
+parity oracle for das_diff_veh_tpu.models.windows.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy.signal import windows as _windows
+
+
+def lin_interp_extrap(xq: np.ndarray, xs: np.ndarray, ys: np.ndarray) -> np.ndarray:
+    """Piecewise-linear interp with linear end-segment extrapolation —
+    scipy interp1d(fill_value='extrapolate') / extrap1d behavior."""
+    xq = np.atleast_1d(np.asarray(xq, dtype=float))
+    i = np.clip(np.searchsorted(xs, xq, side="right") - 1, 0, len(xs) - 2)
+    w = (xq - xs[i]) / (xs[i + 1] - xs[i])
+    return ys[i] + w * (ys[i + 1] - ys[i])
+
+
+def ref_traj_mute_mask(x_axis: np.ndarray, t_axis: np.ndarray,
+                       traj_x: np.ndarray, traj_t: np.ndarray, dx: float,
+                       offset: float = 200.0, alpha: float = 0.3,
+                       delta_x: float = 20.0,
+                       double_sided: bool = False) -> np.ndarray:
+    """Per-time-sample Tukey mask loop (reference apis/data_classes.py:60-70,86-96)."""
+    nx = x_axis.size
+    n_samp = int(offset / dx)
+    tuk = _windows.tukey(n_samp, alpha)
+    car_positions = lin_interp_extrap(t_axis, traj_t, traj_x)
+    mask = np.zeros((nx, t_axis.size))
+    for k, car_loc in enumerate(car_positions):
+        center_x = car_loc if double_sided else car_loc - offset / 2 + delta_x
+        center_idx = int(np.argmax(x_axis > center_x))
+        lo = max(0, center_idx - n_samp // 2)
+        hi = min(nx, center_idx + n_samp // 2)
+        tlo = lo + n_samp // 2 - center_idx
+        mask[lo:hi, k] = tuk[tlo:tlo + hi - lo]
+    return mask
+
+
+def ref_select_windows(data: np.ndarray, x: np.ndarray, t: np.ndarray,
+                       veh_t_idx: np.ndarray, x_track: np.ndarray,
+                       t_track: np.ndarray, x0: float, wlen_sw: float = 8.0,
+                       length_sw: float = 300.0, spatial_ratio: float = 0.75,
+                       temporal_spacing: float | None = None):
+    """locate_windows (reference apis/data_classes.py:170-223) on raw arrays.
+
+    ``veh_t_idx``: (nveh, n_track_ch) float arrival sample indices sorted by
+    arrival (detection order).  Returns (accepted_ids, window_data_list,
+    start_t_indices, x_slice).
+    """
+    dt = t[1] - t[0]
+    spacing = temporal_spacing if temporal_spacing else wlen_sw
+    win_nsamp = int(wlen_sw / dt)
+    x0_track_idx = int(np.abs(x_track - x0).argmin())
+
+    start_x = x0 - length_sw * spatial_ratio
+    end_x = start_x + length_sw
+    sxi = int(np.abs(start_x - x).argmin())
+    exi = int(np.abs(end_x - x).argmin())
+
+    accepted, wins, starts = [], [], []
+    nveh = veh_t_idx.shape[0]
+    for k in range(nveh):
+        raw = veh_t_idx[k, x0_track_idx]
+        if not np.isfinite(raw):
+            continue
+        t0 = t_track[int(raw)]
+        if k < nveh - 1 and np.isfinite(veh_t_idx[k + 1, x0_track_idx]):
+            t0_next = t_track[int(veh_t_idx[k + 1, x0_track_idx])]
+            if t0_next - t0 < spacing:
+                continue
+        if k > 0 and np.isfinite(veh_t_idx[k - 1, x0_track_idx]):
+            t0_prev = t_track[int(veh_t_idx[k - 1, x0_track_idx])]
+            if spacing > t0 - t0_prev >= 0:
+                continue
+        t0_sw_idx = int(np.abs(t0 - t).argmin())
+        if t0_sw_idx < win_nsamp // 2 or t0_sw_idx + win_nsamp // 2 > t.size:
+            continue
+        st = t0_sw_idx - win_nsamp // 2
+        accepted.append(k)
+        starts.append(st)
+        wins.append(data[sxi:exi, st:st + win_nsamp].copy())
+    return accepted, wins, starts, slice(sxi, exi)

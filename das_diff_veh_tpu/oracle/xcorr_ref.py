@@ -52,14 +52,23 @@ def ref_xcorr_traj_follow(data: np.ndarray, t_axis: np.ndarray, pivot_idx: int,
                           ch_indices: np.ndarray, t_at_ch: np.ndarray,
                           nsamp: int, wlen: int, overlap_ratio: float = 0.5,
                           reverse: bool = False) -> np.ndarray:
+    """Numpy-slice parity: the forward window [ti, ti+nsamp) truncates at the
+    record end (fewer correlation windows); the backward window
+    [ti-nsamp, ti) is *empty* when ti < nsamp (numpy negative-start slice,
+    reference apis/virtual_shot_gather.py:31) and the row stays zero."""
     out = np.zeros((len(ch_indices), wlen))
-    nt = data.shape[-1]
     for k, (ch, t_target) in enumerate(zip(ch_indices, t_at_ch)):
         ti = int(np.argmax(t_axis >= t_target))
-        start = ti - nsamp if reverse else ti
-        start = min(max(start, 0), nt - nsamp)
-        tr_ch = data[ch, start:start + nsamp]
-        tr_pv = data[pivot_idx, start:start + nsamp]
+        if reverse:
+            if ti - nsamp < 0:
+                continue
+            sl = slice(ti - nsamp, ti)
+        else:
+            sl = slice(ti, ti + nsamp)
+        tr_ch = data[ch, sl]
+        tr_pv = data[pivot_idx, sl]
+        if tr_ch.size < wlen:
+            continue
         if reverse:
             out[k] = ref_xcorr_pair(tr_pv, tr_ch, wlen, overlap_ratio)
         else:

@@ -1,0 +1,10 @@
+"""Multi-device execution: meshes, sharded window stacking, collectives.
+
+The reference is single-process NumPy (SURVEY.md §5: no distributed backend);
+its scaling unit is the per-vehicle window.  Here the window axis shards over
+a ``jax.sharding.Mesh`` — each device builds its local gathers and the masked
+mean stack turns into an XLA all-reduce inserted by pjit.
+"""
+
+from das_diff_veh_tpu.parallel.mesh import make_mesh, pad_batch  # noqa: F401
+from das_diff_veh_tpu.parallel.stack import sharded_stack_pipeline  # noqa: F401

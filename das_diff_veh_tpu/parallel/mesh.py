@@ -1,0 +1,47 @@
+"""Device-mesh helpers.
+
+One logical axis for now — ``"win"`` (data parallelism over per-vehicle
+windows, the framework's natural scaling unit; BASELINE.md config 3).  The
+helpers accept any device count: the driver dry-runs with N virtual CPU
+devices (``xla_force_host_platform_device_count``), CI uses 8, hardware uses
+whatever the slice provides.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from das_diff_veh_tpu.core.section import WindowBatch
+
+
+def make_mesh(n_devices: int | None = None, axis: str = "win") -> Mesh:
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if n > len(devices):
+        raise ValueError(f"requested {n} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[:n]), (axis,))
+
+
+def pad_batch(batch: WindowBatch, multiple: int) -> WindowBatch:
+    """Pad the window axis to a device-count multiple with invalid slots.
+
+    Masked stacking ignores padding, so results are unchanged; shapes become
+    shardable without ragged remainders.
+    """
+    b = batch.max_windows
+    pad = (-b) % multiple
+    if pad == 0:
+        return batch
+    def pad0(a):
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return jax.numpy.pad(a, widths)
+    return dataclasses.replace(
+        batch,
+        data=pad0(batch.data), t=pad0(batch.t),
+        traj_x=pad0(batch.traj_x), traj_t=pad0(batch.traj_t),
+        valid=pad0(batch.valid),
+    )

@@ -1,0 +1,71 @@
+"""Benchmark / dry-run workload builders.
+
+Produces reference-geometry window batches (BASELINE.md problem geometry:
+dx = 8.16 m, fs = 250 Hz, ~8 s x 300 m windows, 700 m pivot, class stacks of
+~60 windows) filled with synthetic dispersive wavefields and linear vehicle
+trajectories — the shapes the reference's 700 m imaging path processes
+(apis/imaging_classes.py save_disp_imgs / bootstrap_disp).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from das_diff_veh_tpu.config import GatherConfig, WindowConfig
+from das_diff_veh_tpu.core.section import WindowBatch
+from das_diff_veh_tpu.io.synthetic import default_phase_velocity, dispersive_shot
+from das_diff_veh_tpu.models.vsg import VsgGeometry
+
+
+def make_window_batch(n_windows: int = 60, x0: float = 700.0,
+                      fs: float = 250.0, dx: float = 8.16,
+                      wcfg: WindowConfig = WindowConfig(),
+                      noise: float = 0.3, seed: int = 0,
+                      dtype=np.float32):
+    """(WindowBatch, x_axis) with reference geometry and dispersive content.
+
+    Each window holds a dispersive surface-wave shot radiating from the
+    vehicle's pivot crossing plus noise; trajectories are linear with
+    per-window random speeds, crossing the pivot mid-window.
+    """
+    rng = np.random.default_rng(seed)
+    dt = 1.0 / fs
+    nx = int(wcfg.length_sw / dx)
+    nt = int(wcfg.wlen_sw / dt)
+    start_x = x0 - wcfg.length_sw * wcfg.spatial_ratio
+    x = start_x + np.arange(nx) * dx
+    pivot_ch = int(np.argmax(x >= x0))
+
+    base = dispersive_shot(nx, nt, dx, dt, default_phase_velocity,
+                           src_idx=pivot_ch)
+    base = base / np.abs(base).max()
+
+    data = np.empty((n_windows, nx, nt), dtype=dtype)
+    t = np.empty((n_windows, nt), dtype=dtype)
+    n_traj = 64
+    traj_x = np.empty((n_windows, n_traj), dtype=dtype)
+    traj_t = np.empty((n_windows, n_traj), dtype=dtype)
+    for w in range(n_windows):
+        # all windows share t0 = 0: float32 time axes keep full dt precision
+        # (absolute offsets like 100*w would quantize 4 ms steps at ~600 s)
+        t0 = 0.0
+        t[w] = t0 + np.arange(nt, dtype=np.float64) * dt
+        data[w] = base + noise * rng.standard_normal((nx, nt))
+        speed = rng.uniform(10.0, 22.0)
+        t_pivot = t0 + nt // 2 * dt
+        tx = np.linspace(x[0] - 50.0, x[-1] + 50.0, n_traj)
+        traj_x[w] = tx
+        traj_t[w] = t_pivot + (tx - x0) / speed
+    batch = WindowBatch(data=jnp.asarray(data), x=jnp.asarray(x.astype(dtype)),
+                        t=jnp.asarray(t), traj_x=jnp.asarray(traj_x),
+                        traj_t=jnp.asarray(traj_t),
+                        valid=jnp.ones(n_windows, bool))
+    return batch, x
+
+
+def make_gather_geometry(x: np.ndarray, x0: float = 700.0, fs: float = 250.0,
+                         cfg: GatherConfig = GatherConfig()) -> VsgGeometry:
+    """Reference gather geometry for a window batch: offsets start_x .. end_x
+    around the pivot (the notebooks' 700 m setup, x0-150 .. x0+75)."""
+    return VsgGeometry.build(x, 1.0 / fs, x0, x0 - 150.0, x0 + 75.0, cfg)

@@ -1,0 +1,54 @@
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from das_diff_veh_tpu.config import DispersionConfig, GatherConfig, WindowConfig
+from das_diff_veh_tpu.models import vsg as V
+from das_diff_veh_tpu.models.vsg import VsgGeometry
+from das_diff_veh_tpu.parallel import make_mesh
+from das_diff_veh_tpu.parallel.stack import shard_windows, sharded_stack_pipeline
+from das_diff_veh_tpu.workloads import make_window_batch
+
+
+def _tiny_workload(n_windows):
+    wcfg = WindowConfig(wlen_sw=2.0, length_sw=120.0)
+    gcfg = GatherConfig(wlen=0.5, time_window=1.0)
+    dcfg = DispersionConfig(freq_step=0.5, vel_step=20.0)
+    batch, x = make_window_batch(n_windows=n_windows, fs=50.0, wcfg=wcfg,
+                                 dtype=np.float64)
+    g = VsgGeometry.build(x, 1.0 / 50.0, 700.0, 640.0, 730.0, gcfg)
+    return batch, x, g, gcfg, dcfg
+
+
+def test_sharded_stack_matches_single_device():
+    assert len(jax.devices()) >= 8, "conftest must fake 8 CPU devices"
+    batch, x, g, gcfg, dcfg = _tiny_workload(n_windows=8)
+    offs = g.offsets(x)
+
+    # single-device reference
+    stack1 = V.stack_gathers(V.build_gather_batch(batch, g, gcfg), batch.valid)
+    img1 = V.gather_disp_image(stack1, offs, g.dt, 8.16, dcfg, -60.0, 0.0)
+
+    mesh = make_mesh(8)
+    sharded = shard_windows(batch, mesh)
+    stack8, img8 = sharded_stack_pipeline(sharded, g, offs, mesh, gcfg, dcfg,
+                                          disp_start_x=-60.0, disp_end_x=0.0)
+    np.testing.assert_allclose(np.asarray(stack8), np.asarray(stack1),
+                               rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(img8), np.asarray(img1),
+                               rtol=1e-9, atol=1e-12)
+
+
+def test_sharded_stack_pads_ragged_batch():
+    """A window count that doesn't divide the mesh is padded with invalid
+    slots and yields the same masked mean."""
+    batch, x, g, gcfg, dcfg = _tiny_workload(n_windows=5)
+    offs = g.offsets(x)
+    stack1 = V.stack_gathers(V.build_gather_batch(batch, g, gcfg), batch.valid)
+    mesh = make_mesh(8)
+    sharded = shard_windows(batch, mesh)
+    assert sharded.data.shape[0] == 8
+    stack8, _ = sharded_stack_pipeline(sharded, g, offs, mesh, gcfg, dcfg,
+                                       disp_start_x=-60.0, disp_end_x=0.0)
+    np.testing.assert_allclose(np.asarray(stack8), np.asarray(stack1),
+                               rtol=1e-9, atol=1e-12)

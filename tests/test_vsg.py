@@ -1,0 +1,128 @@
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from das_diff_veh_tpu.config import GatherConfig
+from das_diff_veh_tpu.models import vsg as V
+from das_diff_veh_tpu.oracle import vsg_ref as OV
+from das_diff_veh_tpu.ops import xcorr as jx
+from das_diff_veh_tpu.oracle import xcorr_ref as ox
+
+RNG = np.random.default_rng(23)
+
+
+def _window_scene(nt=2000, nx=37, fs=250.0, dx=8.16, x0=500.0, speed=15.0,
+                  pivot_frac=0.5):
+    """One per-vehicle window: data + axes + trajectory through the pivot.
+
+    ``pivot_frac`` places the vehicle's pivot arrival inside the window:
+    ~0.5 keeps the forward (main-side) correlation windows live; ~0.75 makes
+    the time-reversed other-side windows live instead.
+    """
+    t = 100.0 + np.arange(nt) / fs
+    x = x0 - 225.0 + np.arange(nx) * dx
+    t_pivot = t[int(nt * pivot_frac)]
+    traj_t = np.linspace(t_pivot - 40.0, t_pivot + 40.0, 80)
+    traj_x = x0 + (traj_t - t_pivot) * speed
+    data = RNG.standard_normal((nx, nt))
+    return data, x, t, traj_x, traj_t, x0
+
+
+@pytest.mark.parametrize("other_side,pivot_frac",
+                         [(False, 0.5), (True, 0.5), (True, 0.75)])
+def test_build_gather_matches_reference(other_side, pivot_frac):
+    data, x, t, traj_x, traj_t, x0 = _window_scene(pivot_frac=pivot_frac)
+    cfg = GatherConfig(include_other_side=other_side)
+    start_x, end_x = x0 - 150.0, x0 + 75.0
+    ref, roff, rlags = OV.ref_build_gather(
+        data, x, t, traj_x, traj_t, x0, start_x, end_x,
+        wlen_s=cfg.wlen, time_window=cfg.time_window, delta_t=cfg.delta_t,
+        include_other_side=other_side)
+    g = V.VsgGeometry.build(x, t[1] - t[0], x0, start_x, end_x, cfg)
+    ours = np.asarray(V.build_gather(
+        jnp.asarray(data), jnp.asarray(t), jnp.asarray(x),
+        jnp.asarray(traj_x), jnp.asarray(traj_t),
+        jnp.ones(traj_t.size, bool), g, cfg))
+    assert ours.shape == ref.shape == (g.nch_out, g.wlen)
+    np.testing.assert_allclose(ours, ref, rtol=1e-7, atol=1e-9)
+    np.testing.assert_allclose(g.offsets(x), roff, rtol=1e-12)
+    np.testing.assert_allclose(g.lags(), rlags, rtol=1e-12)
+
+
+def test_build_gather_jits_and_vmaps():
+    data, x, t, traj_x, traj_t, x0 = _window_scene()
+    cfg = GatherConfig()
+    g = V.VsgGeometry.build(x, t[1] - t[0], x0, x0 - 150.0, x0 + 75.0, cfg)
+    fn = jax.jit(lambda d, tt, tx, tj: V.build_gather(
+        d, tt, jnp.asarray(x), tx, tj, jnp.isfinite(tj), g, cfg))
+    batch_d = jnp.asarray(np.stack([data, data * 0.5]))
+    batch_t = jnp.asarray(np.stack([t, t]))
+    batch_tx = jnp.asarray(np.stack([traj_x, traj_x]))
+    batch_tt = jnp.asarray(np.stack([traj_t, traj_t]))
+    out = jax.vmap(fn)(batch_d, batch_t, batch_tx, batch_tt)
+    assert out.shape == (2, g.nch_out, g.wlen)
+    # gather is invariant to a global amplitude scale (global-L2 preprocess)
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(out[1]),
+                               rtol=1e-6, atol=1e-9)
+
+
+def test_stack_gathers_masks_invalid():
+    a = jnp.asarray(RNG.standard_normal((3, 4, 8)))
+    valid = jnp.asarray([True, True, False])
+    out = np.asarray(V.stack_gathers(a, valid))
+    np.testing.assert_allclose(out, np.asarray((a[0] + a[1]) / 2.0), rtol=1e-12)
+
+
+@pytest.mark.parametrize("backward", [False, True])
+def test_xcorr_pair_at_truncation_parity(backward):
+    """Masked static-shape windows reproduce numpy truncation/empty slices."""
+    nt, wlen, nsamp = 1200, 200, 700
+    a = RNG.standard_normal(nt)
+    b = RNG.standard_normal(nt)
+    for start in [0, 300, 650, 900, 1150]:
+        if backward:
+            if start - nsamp < 0:
+                ref = np.zeros(wlen)
+            else:
+                sl = slice(start - nsamp, start)
+                ref = ox.ref_xcorr_pair(a[sl], b[sl], wlen)
+        else:
+            sl = slice(start, start + nsamp)
+            if a[sl].size < wlen:
+                ref = np.zeros(wlen)
+            else:
+                ref = ox.ref_xcorr_pair(a[sl], b[sl], wlen)
+        ours = np.asarray(jx.xcorr_pair_at(jnp.asarray(a), jnp.asarray(b),
+                                           start, nsamp, wlen, backward=backward))
+        np.testing.assert_allclose(ours, np.atleast_1d(np.squeeze(ref)),
+                                   rtol=1e-8, atol=1e-10, err_msg=f"start={start}")
+
+
+def test_gather_physics_moveout():
+    """VSG of a non-dispersive propagating field peaks at lag = offset/c."""
+    nt, fs, dx, c = 4000, 250.0, 8.16, 500.0
+    nx = 37
+    x = np.arange(nx) * dx
+    t = np.arange(nt) / fs
+    # plane wave sweeping from the far end toward channel 0 repeatedly
+    rng = np.random.default_rng(3)
+    src = rng.standard_normal(nt * 2)
+    data = np.stack([np.interp(t - xi / c, np.arange(-nt, nt) / fs, src)
+                     for xi in x])
+    pivot = x[-1]
+    traj_t = np.array([t[0] - 20.0, t[-1] + 20.0])
+    traj_x = np.array([x[-1] + 300.0, x[-1] + 301.0])  # car far away: fixed window
+    cfg = GatherConfig(delta_t=-50.0, time_window=10.0, norm_amp=False,
+                       include_other_side=False)
+    g = V.VsgGeometry.build(x, 1.0 / fs, pivot, 0.0, pivot, cfg)
+    out = np.asarray(V.build_gather(jnp.asarray(data), jnp.asarray(t),
+                                    jnp.asarray(x), jnp.asarray(traj_x),
+                                    jnp.asarray(traj_t),
+                                    jnp.ones(2, bool), g, cfg))
+    lags = g.lags()
+    offsets = g.offsets(x)
+    for row in [5, 15, 25]:
+        lag_peak = lags[np.argmax(out[row])]
+        expect = abs(offsets[row]) / c
+        assert abs(abs(lag_peak) - expect) < 0.05, (row, lag_peak, expect)
