@@ -48,6 +48,11 @@ class TrackingConfig:
     gate_hi: float = 30.0
     meas_noise: float = 1.0           # R
     max_vehicles: int = 64            # static capacity for jit
+    # The reference's "prefer smallest positive lag" pick indexes the gate
+    # subset with a positive-subset index (apis/tracking.py:132-135), so with
+    # mixed-sign lags in the gate it actually records the *first* gated peak.
+    # True reproduces that behavior bit-for-bit; False implements the intent.
+    assoc_bug_compat: bool = True
 
 
 @dataclass(frozen=True)

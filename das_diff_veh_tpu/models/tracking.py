@@ -1,0 +1,260 @@
+"""Kalman-filter vehicle tracking on the quasi-static band.
+
+TPU re-design of the reference tracker (apis/tracking.py:21-168,
+modules/car_tracking_utils.py:21-66): the per-channel per-vehicle Python
+double loop becomes one ``lax.scan`` over strided channels carrying all
+vehicle states at once; peak detection is precomputed for every strided
+channel as a vmapped batch (ops.peaks); track QC and NaN handling are
+vectorized masks over fixed-capacity state tensors.
+
+State model per vehicle (reference :84-155): 2-state [arrival-time sample
+index, slowness] KF marched along channels; predict with A=[[1,dx],[0,1]] and
+process noise Q = sigma_a*[[dx^4/4, dx^3/2],[dx^3/2, dx^2]]; asymmetric data
+association gate (-15, +30] samples preferring the nearest *positive* lag;
+update with C=[1,0], R=1 once a track has >2 recorded samples.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from das_diff_veh_tpu.config import TrackQCConfig, TrackingConfig
+from das_diff_veh_tpu.core.section import VehicleTracks
+from das_diff_veh_tpu.ops.interp import masked_interp_clamped
+from das_diff_veh_tpu.ops.peaks import find_peaks, gaussian_likelihood
+
+
+def detect_vehicle_base(data: jnp.ndarray, t_axis: jnp.ndarray,
+                        start_x_idx: int, cfg: TrackingConfig = TrackingConfig()):
+    """Stacked-likelihood vehicle arrival detection over ``n_detect_channels``
+    consecutive channels at the section start (reference
+    detect_in_one_section, apis/tracking.py:21-63).
+
+    Returns (base_idx (max_vehicles,) int32, valid (max_vehicles,)).
+    """
+    det = cfg.detect
+    rows = jax.lax.dynamic_slice_in_dim(data, start_x_idx, cfg.n_detect_channels, 0)
+    pk_pos, pk_valid = jax.vmap(
+        lambda tr: find_peaks(tr, det.min_prominence, det.min_separation,
+                              det.prominence_wlen, det.max_peaks))(rows)
+    like = jax.vmap(lambda p, v: gaussian_likelihood(p, v, t_axis,
+                                                     cfg.likelihood_sigma))(pk_pos, pk_valid)
+    stacked = jnp.sum(like, axis=0)
+    # reference :44: find_peaks(height=0, distance=minseparation) — local
+    # maxima + distance pruning only
+    base, valid = find_peaks(stacked, min_distance=det.min_separation,
+                             max_peaks=cfg.max_vehicles, use_prominence=False)
+    return base, valid
+
+
+class _KFCarry(NamedTuple):
+    Tkk: jnp.ndarray       # (nveh, 2)
+    Pkk: jnp.ndarray       # (nveh, 2, 2)
+    Xv: jnp.ndarray        # (nveh,) x of last update (or first obs)
+    count: jnp.ndarray     # (nveh,) recorded (non-NaN) samples so far
+    obs1: jnp.ndarray      # (nveh,) first recorded sample index
+    obs1_x: jnp.ndarray    # (nveh,) x where it was recorded
+
+
+def _associate(pk_pos, pk_valid, pred, gate_lo, gate_hi, bug_compat=True):
+    """Reference data association (apis/tracking.py:124-141): inside the
+    asymmetric gate prefer a positive lag, else the smallest absolute lag;
+    NaN when the gate is empty.
+
+    ``bug_compat=True`` reproduces the reference's subset-indexing slip
+    (:132-135): when a positive lag exists the *first* gated peak is recorded
+    (which is the smallest positive only when no negative lags are gated).
+    ``False`` records the smallest positive lag — the evident intent.
+    """
+    dist = pk_pos.astype(jnp.float32) - pred
+    in_gate = pk_valid & (dist > gate_lo) & (dist <= gate_hi)
+    pos = in_gate & (dist > 0)
+    big = jnp.inf
+    i_pos = (jnp.argmax(in_gate) if bug_compat
+             else jnp.argmin(jnp.where(pos, dist, big)))
+    i_abs = jnp.argmin(jnp.where(in_gate, jnp.abs(dist), big))
+    any_pos = jnp.any(pos)
+    any_gate = jnp.any(in_gate)
+    choice = jnp.where(any_pos, i_pos, i_abs)
+    return jnp.where(any_gate, pk_pos[choice].astype(pred.dtype), jnp.nan)
+
+
+def track_vehicles(data: jnp.ndarray, x_axis, start_x: float,
+                   end_x: float, base: jnp.ndarray, base_valid: jnp.ndarray,
+                   cfg: TrackingConfig = TrackingConfig()):
+    """March the per-vehicle KF along strided channels (reference
+    tracking_with_veh_base, apis/tracking.py:65-156).
+
+    ``x_axis``/``t_axis`` must be concrete (host) arrays.  Returns
+    ``(veh_states (max_vehicles, n_steps) float — recorded arrival sample
+    index per strided channel, NaN where unassociated; step_x (n_steps,))``.
+    """
+    x_axis = np.asarray(x_axis)
+    start_x_idx = int(np.abs(start_x - x_axis).argmin())
+    end_x_idx = int(np.abs(end_x - x_axis).argmin())
+    step_idx = np.arange(start_x_idx, end_x_idx + 1, cfg.channel_stride)
+    step_x = x_axis[step_idx]
+    det = cfg.detect
+    nveh = base.shape[0]
+
+    rows = data[step_idx]
+    pk_pos, pk_valid = jax.vmap(
+        lambda tr: find_peaks(tr, det.min_prominence, det.min_separation,
+                              det.prominence_wlen, det.max_peaks))(rows)
+
+    base_f = jnp.where(base_valid, base, 0).astype(jnp.float32)
+    init = _KFCarry(
+        Tkk=jnp.zeros((nveh, 2), jnp.float32),
+        Pkk=jnp.zeros((nveh, 2, 2), jnp.float32),
+        Xv=jnp.zeros((nveh,), jnp.float32),
+        count=jnp.zeros((nveh,), jnp.int32),
+        obs1=jnp.zeros((nveh,), jnp.float32),
+        obs1_x=jnp.zeros((nveh,), jnp.float32),
+    )
+
+    def step(carry: _KFCarry, inp):
+        x_i, pos_i, valid_i = inp
+        c0 = carry.count == 0
+        c1 = carry.count == 1
+        # the count==1 branch (reference :104-109) persistently re-seeds the
+        # state from the single recorded sample
+        Tkk = jnp.where(c1[:, None],
+                        jnp.stack([carry.obs1, jnp.zeros_like(carry.obs1)], -1),
+                        carry.Tkk)
+        Pkk = jnp.where(c1[:, None, None], 0.0, carry.Pkk)
+        Xv = jnp.where(c1, carry.obs1_x, carry.Xv)
+
+        dx = x_i - Xv                                             # (nveh,)
+        A = jnp.stack([jnp.stack([jnp.ones_like(dx), dx], -1),
+                       jnp.stack([jnp.zeros_like(dx), jnp.ones_like(dx)], -1)], -2)
+        Q = cfg.sigma_a * jnp.stack(
+            [jnp.stack([0.25 * dx ** 4, 0.5 * dx ** 3], -1),
+             jnp.stack([0.5 * dx ** 3, dx ** 2], -1)], -2)
+        Tk1k = jnp.einsum("vij,vj->vi", A, Tkk)
+        Pk1k = jnp.einsum("vij,vjk,vlk->vil", A, Pkk, A) + Q
+        pred = jnp.where(c0 | c1, base_f, Tk1k[:, 0])
+
+        obs = jax.vmap(lambda p: _associate(pos_i, valid_i, p,
+                                            cfg.gate_lo, cfg.gate_hi,
+                                            cfg.assoc_bug_compat))(pred)
+        obs = jnp.where(base_valid, obs, jnp.nan)                 # padded slots stay empty
+        rec = jnp.isfinite(obs)
+        count = carry.count + rec.astype(jnp.int32)
+
+        newly_first = rec & c0
+        obs1 = jnp.where(newly_first, obs, carry.obs1)
+        obs1_x = jnp.where(newly_first, x_i, carry.obs1_x)
+
+        do_upd = (count > 2) & rec
+        K = Pk1k[:, :, 0] / (cfg.meas_noise + Pk1k[:, 0, 0])[:, None]   # (nveh, 2)
+        innov = jnp.where(rec, obs - Tk1k[:, 0], 0.0)
+        Tkk_new = Tk1k + K * innov[:, None]
+        Pkk_new = Pk1k - K[:, :, None] * Pk1k[:, 0:1, :]
+        Tkk = jnp.where(do_upd[:, None], Tkk_new, Tkk)
+        Pkk = jnp.where(do_upd[:, None, None], Pkk_new, Pkk)
+        Xv = jnp.where(do_upd, x_i, Xv)
+
+        return _KFCarry(Tkk, Pkk, Xv, count, obs1, obs1_x), obs
+
+    xs = (jnp.asarray(step_x, jnp.float32), pk_pos, pk_valid)
+    _, states = jax.lax.scan(step, init, xs)
+    return states.T, step_x                                       # (nveh, n_steps)
+
+
+def _compact(vals: jnp.ndarray, valid: jnp.ndarray):
+    """Stable compaction: valid entries first, original order preserved."""
+    n = vals.shape[-1]
+    key = jnp.where(valid, jnp.arange(n), n + jnp.arange(n))
+    order = jnp.argsort(key)
+    return vals[order], valid[order]
+
+
+def track_qc(veh_states: jnp.ndarray, qc: TrackQCConfig = TrackQCConfig()):
+    """Vectorized remove_unrealistic_tracking
+    (modules/car_tracking_utils.py:38-66) on the strided state array.
+
+    Returns ``(veh_states with >max_jump jumps NaN'd, keep (nveh,) mask)``.
+    Rejection tests use the pre-jump-masked values, like the reference.
+    """
+    ns = veh_states.shape[-1]
+    w = int(qc.retrograde_window)
+
+    def one(row):
+        valid = jnp.isfinite(row)
+        nv = jnp.sum(valid)
+        vals, _ = _compact(jnp.where(valid, row, 0.0), valid)
+        d = vals[1:] - vals[:-1]                     # diffs of consecutive valid samples
+        nd = nv - 1
+        d_ok = jnp.arange(d.shape[0]) < nd
+        # retrograde: any 20-diff sliding sum <= threshold (conv 'valid');
+        # with fewer than 20 diffs numpy's 'valid' convolve emits partial
+        # sums all equal to sum(d), so total drift is tested instead
+        cs = jnp.concatenate([jnp.zeros(1), jnp.cumsum(jnp.where(d_ok, d, 0.0))])
+        win_sum = cs[w:] - cs[:-w]
+        win_ok = jnp.arange(win_sum.shape[0]) + w <= nd
+        retro_full = jnp.any(win_ok & (win_sum <= qc.retrograde_threshold))
+        total = cs[jnp.clip(nd, 0, d.shape[0])]
+        retro_partial = (nd > 0) & (nd < w) & (total <= qc.retrograde_threshold)
+        retrograde = retro_full | retro_partial
+        # total travel |last - first| scaled by coverage
+        first = vals[0]
+        last = vals[jnp.maximum(nv - 1, 0)]
+        short = jnp.abs(last - first) < qc.min_travel_samples * (nv / ns)
+        # adjacent-NaN pairs
+        nanrow = ~valid
+        adjacency = jnp.sum(nanrow[1:] & nanrow[:-1])
+        reject = ((nv < qc.min_valid_fraction * ns) | retrograde | short |
+                  (adjacency >= qc.max_adjacent_nan))
+        # jump masking: the later sample of any |diff| > max_jump pair -> NaN
+        jump = d_ok & (jnp.abs(d) > qc.max_jump)
+        valid_pos = jnp.cumsum(valid) - 1                 # rank of each valid sample
+        # sample with rank r+1 is NaN'd when diff r jumps
+        jump_padded = jnp.concatenate([jnp.zeros(1, bool), jump])
+        masked = jnp.where(valid & jump_padded[jnp.clip(valid_pos, 0, ns - 1)],
+                           jnp.nan, row)
+        return masked, ~reject
+
+    masked, keep = jax.vmap(one)(veh_states)
+    return masked, keep
+
+
+def upsample_tracks(veh_states: jnp.ndarray, factor: int, n_out: int) -> jnp.ndarray:
+    """Spread strided states onto the full channel grid and fill NaNs with
+    np.interp semantics — linear inside the valid span, clamped to the edge
+    values outside (reference tracking.py:162-166 + interp_nan_value)."""
+    ns = veh_states.shape[-1]
+    pos = jnp.arange(ns, dtype=veh_states.dtype) * factor
+    q = jnp.arange(n_out, dtype=veh_states.dtype)
+
+    def one(row):
+        valid = jnp.isfinite(row)
+        return masked_interp_clamped(q, pos, jnp.where(valid, row, 0.0), valid)
+
+    return jax.vmap(one)(veh_states)
+
+
+def track_section(data: jnp.ndarray, x_axis, t_axis, start_x: float,
+                  end_x: float, cfg: TrackingConfig = TrackingConfig(),
+                  qc: TrackQCConfig = TrackQCConfig()) -> VehicleTracks:
+    """detect -> KF -> QC -> upsample: the full tracking stage
+    (reference track_cars, apis/timeLapseImaging.py:104-119 +
+    tracking.py:160-168).  Returns a VehicleTracks pytree on the tracking
+    grid restricted to [start_x, end_x]."""
+    x_axis = np.asarray(x_axis)
+    t_axis = np.asarray(t_axis)
+    start_x_idx = int(np.abs(start_x - x_axis).argmin())
+    end_x_idx = int(np.abs(end_x - x_axis).argmin())
+    base, base_valid = detect_vehicle_base(data, jnp.asarray(t_axis),
+                                           start_x_idx, cfg)
+    states, _ = track_vehicles(data, x_axis, start_x, end_x,
+                               base, base_valid, cfg)
+    states, keep = track_qc(states, qc)
+    n_out = end_x_idx - start_x_idx + 1
+    full = upsample_tracks(states, cfg.channel_stride, n_out)
+    return VehicleTracks(t_idx=full, valid=base_valid & keep,
+                         x=jnp.asarray(x_axis[start_x_idx:end_x_idx + 1]),
+                         t=jnp.asarray(t_axis))

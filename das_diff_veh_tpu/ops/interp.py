@@ -17,6 +17,25 @@ import jax.numpy as jnp
 _BIG = 1e30
 
 
+def masked_interp_clamped(xq: jnp.ndarray, xs: jnp.ndarray, ys: jnp.ndarray,
+                          valid: jnp.ndarray) -> jnp.ndarray:
+    """Like :func:`masked_interp` but with ``np.interp`` edge semantics:
+    queries outside the valid span return the first/last valid ``y`` instead
+    of extrapolating (the reference's track NaN-fill uses np.interp,
+    modules/car_tracking_utils.py:28-35)."""
+    xs_f = jnp.where(valid, xs, _BIG)
+    order = jnp.argsort(xs_f)
+    xs_s = xs_f[order]
+    ys_s = jnp.where(valid, ys, 0.0)[order]
+    n_valid = jnp.sum(valid)
+    lo = xs_s[0]
+    hi = xs_s[jnp.maximum(n_valid - 1, 0)]
+    y_lo = ys_s[0]
+    y_hi = ys_s[jnp.maximum(n_valid - 1, 0)]
+    mid = masked_interp(xq, xs, ys, valid)
+    return jnp.where(xq <= lo, y_lo, jnp.where(xq >= hi, y_hi, mid))
+
+
 def masked_interp(xq: jnp.ndarray, xs: jnp.ndarray, ys: jnp.ndarray,
                   valid: jnp.ndarray) -> jnp.ndarray:
     """Piecewise-linear interpolation of ``(xs, ys)`` knots at ``xq``.

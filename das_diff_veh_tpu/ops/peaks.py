@@ -1,0 +1,117 @@
+"""Static-shape peak detection (local maxima + distance pruning + prominence).
+
+TPU re-design of ``scipy.signal.find_peaks(prominence=, wlen=, distance=)`` as
+used by the reference tracker (apis/tracking.py:36-39,122): dense local-maxima
+mask -> ``lax.top_k`` candidate extraction -> sequential-by-priority distance
+pruning (scipy's algorithm, ranked loop instead of a Python while) -> windowed
+prominence from suffix/prefix minima.  Everything is fixed capacity
+(``cap`` candidates, ``max_peaks`` outputs) so the whole detector jit/vmaps
+over channels.
+
+Deliberate deltas vs scipy (documented, tolerance-tested on continuous data):
+plateaus (exact float ties between neighbors) are not peak candidates, and
+only the ``cap`` highest local maxima enter distance pruning — exact whenever
+a trace has <= cap local maxima.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+_BIG = jnp.asarray(2 ** 30, dtype=jnp.int32)
+
+
+def local_maxima(trace: jnp.ndarray) -> jnp.ndarray:
+    """Strict interior local maxima mask (x[i-1] < x[i] > x[i+1])."""
+    mid = (trace[1:-1] > trace[:-2]) & (trace[1:-1] > trace[2:])
+    return jnp.pad(mid, (1, 1), constant_values=False)
+
+
+def _distance_prune(pos: jnp.ndarray, keep: jnp.ndarray, distance: int) -> jnp.ndarray:
+    """scipy _select_by_peak_distance on candidates already sorted by priority
+    (highest first): walk down the ranking; a surviving peak removes every
+    other candidate within ``distance`` samples."""
+    cap = pos.shape[0]
+    ranks = jnp.arange(cap)
+
+    def body(r, kp):
+        alive = kp[r]
+        close = (jnp.abs(pos - pos[r]) < distance) & (ranks != r)
+        return jnp.where(alive, kp & ~close, kp)
+
+    return jax.lax.fori_loop(0, cap, body, keep)
+
+
+def _window_minima(wins: jnp.ndarray, half: int):
+    """Per-candidate left/right prominence bases.
+
+    ``wins``: (cap, 2*half+1) values centered on each candidate, +inf outside
+    the record (scipy clamps its window at the record edge; +inf padding both
+    terminates the search stretch there and stays out of the minima).
+    """
+    c = half
+    center = wins[:, c:c + 1]
+    idx = jnp.arange(half)
+    # left stretch: from the nearest higher sample (or edge) up to the peak
+    left = wins[:, :c]
+    higher = left > center
+    j_hi = jnp.max(jnp.where(higher, idx, -1), axis=1)          # -1 if none
+    # suffix minima toward the center: lmin[:, j] = min(left[:, j:])
+    lmin = jnp.flip(jax.lax.cummin(jnp.flip(left, axis=1), axis=1), axis=1)
+    sel = jnp.clip(j_hi + 1, 0, c - 1)
+    left_base = jnp.take_along_axis(lmin, sel[:, None], axis=1)[:, 0]
+    # right stretch, mirrored so "toward the peak" is again rightward
+    right = jnp.flip(wins[:, c + 1:], axis=1)
+    higher_r = right > center
+    j_hi_r = jnp.max(jnp.where(higher_r, idx, -1), axis=1)
+    rmin = jnp.flip(jax.lax.cummin(jnp.flip(right, axis=1), axis=1), axis=1)
+    sel_r = jnp.clip(j_hi_r + 1, 0, c - 1)
+    right_base = jnp.take_along_axis(rmin, sel_r[:, None], axis=1)[:, 0]
+    return left_base, right_base
+
+
+@functools.partial(jax.jit, static_argnames=("min_distance", "wlen", "max_peaks",
+                                             "cap", "use_prominence"))
+def find_peaks(trace: jnp.ndarray, min_prominence: float = 0.2,
+               min_distance: int = 50, wlen: int = 600, max_peaks: int = 64,
+               cap: int = 512, use_prominence: bool = True):
+    """scipy-compatible peak pick; returns (positions (max_peaks,) int32
+    ascending, valid mask).  Condition order matches scipy: distance first,
+    prominence second."""
+    nt = trace.shape[-1]
+    heights = jnp.where(local_maxima(trace), trace, -jnp.inf)
+    cap = min(cap, nt)
+    vals, pos = jax.lax.top_k(heights, cap)                     # priority order
+    keep = vals > -jnp.inf
+    keep = _distance_prune(pos, keep, int(math.ceil(min_distance)))
+
+    if use_prominence:
+        half = (wlen if wlen % 2 else wlen + 1) // 2            # scipy rounds wlen up to odd
+        offs = jnp.arange(-half, half + 1)
+        gidx = pos[:, None] + offs[None, :]
+        inside = (gidx >= 0) & (gidx < nt)
+        wins = jnp.where(inside, trace[jnp.clip(gidx, 0, nt - 1)], jnp.inf)
+        left_base, right_base = _window_minima(wins, half)
+        prominence = vals - jnp.maximum(left_base, right_base)
+        keep = keep & (prominence >= min_prominence)
+
+    # compact ascending-by-position into max_peaks slots
+    key = jnp.where(keep, pos, _BIG)
+    order = jnp.argsort(key)
+    out_pos = key[order][:max_peaks]
+    valid = out_pos < _BIG
+    return jnp.where(valid, out_pos, 0).astype(jnp.int32), valid
+
+
+def gaussian_likelihood(peak_idx: jnp.ndarray, peak_valid: jnp.ndarray,
+                        t_axis: jnp.ndarray, sigma: float) -> jnp.ndarray:
+    """Sum of normal pdfs centered on peak times (reference ``likelihood_1d``,
+    modules/car_tracking_utils.py:21-26)."""
+    t0 = t_axis[peak_idx]                                        # (npk,)
+    z = (t_axis[None, :] - t0[:, None]) / sigma
+    pdf = jnp.exp(-0.5 * z * z) / (sigma * jnp.sqrt(2.0 * jnp.pi))
+    return jnp.sum(jnp.where(peak_valid[:, None], pdf, 0.0), axis=0)

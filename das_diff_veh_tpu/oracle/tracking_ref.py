@@ -1,0 +1,144 @@
+"""Executable numpy specification of the reference vehicle tracker.
+
+Semantics from apis/tracking.py:21-168 (detection + KF march + association)
+and modules/car_tracking_utils.py:21-66 (likelihood, QC, NaN interpolation),
+using scipy.signal.find_peaks directly.  Parity oracle for
+das_diff_veh_tpu.models.tracking.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy.signal import find_peaks
+
+from das_diff_veh_tpu.config import TrackQCConfig, TrackingConfig
+
+
+def ref_likelihood(peak_loc: np.ndarray, t_axis: np.ndarray, sigma: float) -> np.ndarray:
+    out = np.zeros(t_axis.size)
+    for p in peak_loc:
+        z = (t_axis - t_axis[p]) / sigma
+        out += np.exp(-0.5 * z * z) / (sigma * np.sqrt(2 * np.pi))
+    return out
+
+
+def ref_detect_base(data: np.ndarray, t_axis: np.ndarray, start_x_idx: int,
+                    cfg: TrackingConfig = TrackingConfig()) -> np.ndarray:
+    det = cfg.detect
+    acc = np.zeros(t_axis.size)
+    for i in range(cfg.n_detect_channels):
+        pk = find_peaks(data[start_x_idx + i], prominence=det.min_prominence,
+                        wlen=det.prominence_wlen, distance=det.min_separation)[0]
+        acc += ref_likelihood(pk, t_axis, cfg.likelihood_sigma)
+    base, _ = find_peaks(acc, height=acc.max() * 0.0, distance=det.min_separation)
+    return base
+
+
+def ref_track(data: np.ndarray, x_axis: np.ndarray, start_x: float, end_x: float,
+              veh_base: np.ndarray, cfg: TrackingConfig = TrackingConfig()) -> np.ndarray:
+    """KF march (reference tracking_with_veh_base, apis/tracking.py:65-156).
+    Returns the strided (nveh, n_steps) recorded-state array (NaN = missed)."""
+    det = cfg.detect
+    sxi = int(np.abs(start_x - x_axis).argmin())
+    exi = int(np.abs(end_x - x_axis).argmin())
+    stride = cfg.channel_stride
+    steps = list(range(sxi, exi + 1, stride))
+    nveh = len(veh_base)
+    states = np.full((nveh, len(steps)), np.nan)
+
+    Tkk = np.full((nveh, 2), np.nan)
+    Pkk = np.full((nveh, 2, 2), np.nan)
+    Xv = np.full(nveh, np.nan)
+    obs1 = np.full(nveh, np.nan)
+    obs1_x = np.full(nveh, np.nan)
+    C = np.array([1.0, 0.0])
+
+    for s, i in enumerate(steps):
+        pred = np.empty(nveh)
+        Tk1k = np.full((nveh, 2), np.nan)
+        Pk1k = np.full((nveh, 2, 2), np.nan)
+        for v in range(nveh):
+            count = np.sum(np.isfinite(states[v]))
+            if count == 1:
+                Tkk[v] = [obs1[v], 0.0]
+                Pkk[v] = 0.0
+                Xv[v] = obs1_x[v]
+                pred[v] = veh_base[v]
+            elif count == 0:
+                pred[v] = veh_base[v]
+            else:
+                dx = x_axis[i] - Xv[v]
+                A = np.array([[1.0, dx], [0.0, 1.0]])
+                Q = cfg.sigma_a * np.array([[0.25 * dx ** 4, 0.5 * dx ** 3],
+                                            [0.5 * dx ** 3, dx ** 2]])
+                Tk1k[v] = A @ Tkk[v]
+                Pk1k[v] = A @ Pkk[v] @ A.T + Q
+                pred[v] = Tk1k[v, 0]
+
+        peak_loc = find_peaks(data[i], prominence=det.min_prominence,
+                              wlen=det.prominence_wlen,
+                              distance=det.min_separation)[0]
+        for v in range(nveh):
+            dist = peak_loc - pred[v]
+            gate = np.where((dist > cfg.gate_lo) & (dist <= cfg.gate_hi))[0]
+            gdist = dist[gate]
+            pos = gdist[gdist > 0]
+            if pos.size > 0:
+                if cfg.assoc_bug_compat:
+                    # the reference indexes the gate subset with the
+                    # positive-subset argmin (apis/tracking.py:132-135) ->
+                    # effectively the first gated peak
+                    states[v, s] = peak_loc[gate[int(np.argmin(pos))]]
+                else:
+                    pos_gate = gate[gdist > 0]
+                    states[v, s] = peak_loc[pos_gate[int(np.argmin(pos))]]
+            elif gdist.size > 0:
+                states[v, s] = peak_loc[gate[int(np.argmin(np.abs(gdist)))]]
+            if np.isfinite(states[v, s]) and np.sum(np.isfinite(states[v, :s])) == 0:
+                obs1[v] = states[v, s]
+                obs1_x[v] = x_axis[i]
+
+        for v in range(nveh):
+            count = np.sum(np.isfinite(states[v]))
+            if count > 2 and np.isfinite(states[v, s]):
+                K = Pk1k[v] @ C / (cfg.meas_noise + C @ Pk1k[v] @ C)
+                Tkk[v] = Tk1k[v] + K * (states[v, s] - C @ Tk1k[v])
+                Pkk[v] = Pk1k[v] - (K.reshape(2, 1) @ C.reshape(1, 2)) @ Pk1k[v]
+                Xv[v] = x_axis[i]
+    return states
+
+
+def ref_track_qc(states: np.ndarray, qc: TrackQCConfig = TrackQCConfig()):
+    """remove_unrealistic_tracking (modules/car_tracking_utils.py:38-66) on the
+    strided array; returns (jump-masked states, keep mask)."""
+    out = states.copy()
+    ns = states.shape[-1]
+    keep = np.ones(states.shape[0], bool)
+    w = int(qc.retrograde_window)
+    for v in range(states.shape[0]):
+        row = states[v]
+        tmp = row[np.isfinite(row)]
+        d = np.diff(tmp)
+        retro = np.sum(np.convolve(d, np.ones(w), mode="valid") <= qc.retrograde_threshold) > 0 \
+            if d.size > 0 else False
+        nan_idx = np.where(np.isnan(row))[0]
+        adjacency = np.sum(np.diff(nan_idx) == 1) if nan_idx.size else 0
+        if (tmp.size < qc.min_valid_fraction * ns or retro or
+                abs(np.sum(d)) < qc.min_travel_samples * (tmp.size / ns) or
+                adjacency >= qc.max_adjacent_nan):
+            keep[v] = False
+        vidx = np.where(np.isfinite(row))[0]
+        bad = np.where(np.abs(d) > qc.max_jump)[0]
+        out[v, vidx[bad + 1]] = np.nan
+    return out, keep
+
+
+def ref_upsample(states: np.ndarray, factor: int) -> np.ndarray:
+    """Stride-expand + np.interp NaN fill (reference tracking.py:162-166,
+    car_tracking_utils.py:28-35)."""
+    full = np.full((states.shape[0], states.shape[1] * factor), np.nan)
+    full[:, ::factor] = states
+    for row in full:
+        good = np.where(np.isfinite(row))[0]
+        row[np.isnan(row)] = np.interp(np.where(np.isnan(row))[0], good, row[good])
+    return full
