@@ -1,0 +1,10 @@
+"""Analysis layer: vehicle classification, ridge extraction, bootstrap
+uncertainty, and class summaries — the library form of the reference's
+imaging_diff_* / inversion_diff_* notebook logic."""
+
+from das_diff_veh_tpu.analysis.classify import (  # noqa: F401
+    classify_by_speed, classify_by_weight, majority_speed_mask,
+    majority_weight_mask, quasi_static_peaks, vehicle_speeds)
+from das_diff_veh_tpu.analysis.ridge import extract_ridge  # noqa: F401
+from das_diff_veh_tpu.analysis.bootstrap import (  # noqa: F401
+    bootstrap_disp, convergence_test, sample_indices)

@@ -1,0 +1,102 @@
+"""Bootstrap dispersion uncertainty + convergence study.
+
+The reference recomputes every virtual shot gather for every bootstrap
+repetition (apis/imaging_classes.py:31-36: bt_times × bt_size full gather
+builds).  Stacking is linear in the per-window gathers, so this module
+computes each window's gather ONCE and resamples *stacks* — algebraically
+identical, ~bt_times× cheaper (SURVEY.md §7 step 9) — then images and
+ridge-extracts per repetition under ``lax.map``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from das_diff_veh_tpu.config import BootstrapConfig, DispersionConfig
+from das_diff_veh_tpu.analysis.ridge import extract_ridge
+from das_diff_veh_tpu.models.vsg import gather_disp_image
+
+
+def sample_indices(n_windows: int, bt_size: int, bt_times: int,
+                   rng: np.random.Generator,
+                   exclude_first: bool = True) -> np.ndarray:
+    """(bt_times, bt_size) resampling matrix, without replacement per rep.
+
+    ``exclude_first`` mirrors the reference's ``random.sample(range(1, n))``
+    (apis/imaging_classes.py:32) which never samples window 0.
+    """
+    lo = 1 if exclude_first else 0
+    if bt_size > n_windows - lo:
+        raise ValueError(f"bt_size={bt_size} > available windows {n_windows - lo}")
+    return np.stack([rng.choice(np.arange(lo, n_windows), size=bt_size,
+                                replace=False) for _ in range(bt_times)])
+
+
+def bootstrap_disp(gathers: jnp.ndarray, offsets: np.ndarray, dt: float,
+                   dx: float, idx_matrix: np.ndarray,
+                   cfg: BootstrapConfig = BootstrapConfig(),
+                   disp_cfg: DispersionConfig = DispersionConfig(),
+                   ref_vel: Optional[Sequence] = None,
+                   disp_start_x: float = -150.0, disp_end_x: float = 0.0):
+    """Per-mode bootstrap ridge curves.
+
+    ``gathers``: (n_windows, nch_out, wlen) precomputed per-window VSGs.
+    ``idx_matrix``: (bt_times, bt_size) window indices per repetition.
+    Returns ``(ridges, freqs)`` where ``ridges[mode]`` is (bt_times,
+    n_freqs_in_band) and ``freqs`` is the full scan axis.
+    """
+    freqs = np.arange(disp_cfg.freq_min, disp_cfg.freq_max, disp_cfg.freq_step)
+    vels = np.arange(disp_cfg.vel_min, disp_cfg.vel_max, disp_cfg.vel_step)
+    idx = jnp.asarray(np.asarray(idx_matrix))
+    n_modes = len(cfg.freq_lb)
+    if ref_vel is None:
+        ref_vel = [None] * n_modes
+
+    # two stages: the resampled stacks first (vmap gather+mean), then the
+    # imaging transform mapped over stacks — a traced fancy-index gather of a
+    # closed-over array combined with FFTs inside one lax.map body segfaults
+    # the XLA CPU compiler
+    stacks = jax.vmap(lambda sel: jnp.mean(gathers[sel], axis=0))(idx)
+    images = jax.lax.map(
+        lambda s: gather_disp_image(s, offsets, dt, dx, disp_cfg,
+                                    disp_start_x, disp_end_x),
+        stacks)                                           # (bt_times, nvel, nfreq)
+
+    ridges: List[np.ndarray] = []
+    for m in range(n_modes):
+        band = (freqs >= cfg.freq_lb[m]) & (freqs < cfg.freq_ub[m])
+        # reference: ref index shifted into the band frame
+        # (apis/imaging_classes.py:45)
+        ref_idx = int(cfg.ref_freq_idx[m] - np.sum(freqs < cfg.freq_lb[m]))
+        rv = ref_vel[m]
+        curves = [np.asarray(extract_ridge(
+            freqs[band], vels, img[:, jnp.asarray(band)],
+            ref_freq_idx=None if rv is not None else ref_idx,
+            sigma=float(cfg.sigma[m]), vel_max=cfg.vel_max, ref_vel=rv))
+            for img in images]
+        ridges.append(np.stack(curves))
+    return ridges, freqs
+
+
+def convergence_test(gathers: jnp.ndarray, offsets: np.ndarray, dt: float,
+                     dx: float, max_sample_num: int, bt_times: int,
+                     rng: np.random.Generator,
+                     cfg: BootstrapConfig = BootstrapConfig(),
+                     disp_cfg: DispersionConfig = DispersionConfig(),
+                     ref_vel: Optional[Sequence] = None) -> np.ndarray:
+    """Bootstrap spread vs sample count (imaging_diff_speed.ipynb cell 30):
+    for bt_size = 1..max, run the bootstrap and record the summed per-mode
+    ridge standard deviation.  Returns (n_modes, max_sample_num)."""
+    n_modes = len(cfg.freq_lb)
+    out = np.empty((n_modes, max_sample_num))
+    for bt_size in range(1, max_sample_num + 1):
+        idx = sample_indices(gathers.shape[0], bt_size, bt_times, rng)
+        ridges, _ = bootstrap_disp(gathers, offsets, dt, dx, idx, cfg,
+                                   disp_cfg, ref_vel)
+        for m in range(n_modes):
+            out[m, bt_size - 1] = float(np.sum(np.std(ridges[m], axis=0)))
+    return out

@@ -1,0 +1,108 @@
+"""Vehicle speed/weight classification (library form of the notebook logic).
+
+Sources: imaging_diff_speed.ipynb cells 5-8 (quasi-static peak signature,
+majority filters, mean±sigma speed classes) and imaging_diff_weight.ipynb
+cells 5-8 (1.2 / histogram-mode weight thresholds).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from das_diff_veh_tpu.core.section import VehicleTracks, WindowBatch
+from das_diff_veh_tpu.ops.savgol import savgol_filter
+
+
+def vehicle_speeds(tracks: VehicleTracks) -> jnp.ndarray:
+    """Per-vehicle speed [m/s] from the tracked trajectory: least-squares
+    slope of arrival time vs position over the valid samples.
+
+    (The reference ships precomputed ``veh_speed`` in its pickles —
+    imaging_diff_speed.ipynb cell 2; the tracks are the only source of speed
+    in this framework.)
+    """
+    x = jnp.asarray(tracks.x)
+    dt_track = tracks.t[1] - tracks.t[0]
+
+    def one(row):
+        valid = jnp.isfinite(row)
+        n = jnp.maximum(jnp.sum(valid), 2)
+        t_s = jnp.where(valid, row, 0.0) * dt_track
+        xm = jnp.sum(jnp.where(valid, x, 0.0)) / n
+        tm = jnp.sum(t_s) / n
+        cov = jnp.sum(jnp.where(valid, (x - xm) * (t_s - tm), 0.0))
+        var = jnp.sum(jnp.where(valid, (x - xm) ** 2, 0.0))
+        slowness = cov / jnp.where(var > 0, var, 1.0)         # s/m
+        return jnp.where(jnp.abs(slowness) > 1e-9, 1.0 / jnp.abs(slowness), jnp.nan)
+
+    return jax.vmap(one)(tracks.t_idx)
+
+
+def quasi_static_peaks(qs_batch: WindowBatch, sg_window: int = 101,
+                       sg_order: int = 3) -> jnp.ndarray:
+    """Quasi-static load signature per window: channel-mean trace ->
+    Savitzky-Golay(101,3) -> linear detrend -> re-zero at the first sample ->
+    max |.| (imaging_diff_speed.ipynb cell 5).  NaN for invalid windows."""
+    from das_diff_veh_tpu.ops.filters import detrend_linear
+
+    def one(data):
+        m = jnp.mean(data, axis=0)
+        sm = savgol_filter(m[None, :], sg_window, sg_order, axis=-1)[0]
+        d = detrend_linear(sm[None, :])[0]
+        d = d - d[0]
+        return jnp.max(jnp.abs(d))
+
+    peaks = jax.vmap(one)(qs_batch.data)
+    return jnp.where(qs_batch.valid, peaks, jnp.nan)
+
+
+def _hist_mode(values: np.ndarray, bins: int = 100) -> float:
+    hist, edges = np.histogram(values, bins=bins)
+    return float(edges[int(np.argmax(hist))])
+
+
+def majority_weight_mask(peaks: np.ndarray, frac_sigma: float = 0.3,
+                         bins: int = 100) -> np.ndarray:
+    """Keep the majority-weight population: peaks within ±frac_sigma·std of
+    the histogram mode (imaging_diff_speed.ipynb cell 6)."""
+    peaks = np.asarray(peaks)
+    ok = np.isfinite(peaks)
+    mode = _hist_mode(peaks[ok], bins)
+    sigma = float(np.std(peaks[ok]))
+    return ok & (peaks >= mode - frac_sigma * sigma) & (peaks <= mode + frac_sigma * sigma)
+
+
+def majority_speed_mask(speeds: np.ndarray, n_sigma: float = 1.0) -> np.ndarray:
+    """Keep speeds within mean ± n_sigma·std (imaging_diff_weight.ipynb cell 5)."""
+    speeds = np.asarray(speeds)
+    ok = np.isfinite(speeds)
+    mu, sd = float(np.mean(speeds[ok])), float(np.std(speeds[ok]))
+    return ok & (speeds >= mu - n_sigma * sd) & (speeds <= mu + n_sigma * sd)
+
+
+def classify_by_speed(speeds: np.ndarray):
+    """fast / mid / slow at mean ± std (imaging_diff_speed.ipynb cell 8).
+    Returns three boolean masks."""
+    speeds = np.asarray(speeds)
+    ok = np.isfinite(speeds)
+    hi = float(np.mean(speeds[ok]) + np.std(speeds[ok]))
+    lo = float(np.mean(speeds[ok]) - np.std(speeds[ok]))
+    fast = ok & (speeds > hi)
+    mid = ok & (speeds <= hi) & (speeds > lo)
+    slow = ok & (speeds <= lo)
+    return fast, mid, slow
+
+
+def classify_by_weight(peaks: np.ndarray, heavy_threshold: float = 1.2,
+                       bins: int = 100):
+    """heavy / mid / light: > 1.2, (mode, 1.2], <= histogram mode
+    (imaging_diff_weight.ipynb cell 8).  Returns three boolean masks."""
+    peaks = np.asarray(peaks)
+    ok = np.isfinite(peaks)
+    mode = _hist_mode(peaks[ok], bins)
+    heavy = ok & (peaks > heavy_threshold)
+    mid = ok & (peaks <= heavy_threshold) & (peaks > mode)
+    light = ok & (peaks <= mode)
+    return heavy, mid, light

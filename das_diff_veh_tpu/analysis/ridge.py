@@ -1,0 +1,71 @@
+"""Dispersion-curve (ridge) extraction from f-v maps.
+
+Parity re-design of ``extract_ridge_ref_idx`` (reference
+modules/utils.py:621-678): velocity axis reversed to descending; three modes —
+
+- no reference index: plain argmax per frequency below ``vel_max``;
+- reference index: pick the global argmax at the reference frequency, then
+  walk backward and forward extracting the argmax within ±sigma of the
+  previous pick (mode tracking) — the sequential walks become two
+  ``lax.scan``s;
+- reference curve ``ref_vel(freq)``: masked argmax around the supplied curve
+  per frequency (vectorized).
+
+All masked argmaxes use a -inf fill, which matches the reference's
+first-of-max tie behavior on the compacted subarray.  The picked curve is
+Savitzky-Golay(25,2) smoothed, as in the reference (:676).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from das_diff_veh_tpu.ops.savgol import savgol_filter
+
+
+def _masked_argmax_vel(col: jnp.ndarray, vel: jnp.ndarray, center, sigma: float):
+    mask = (vel > center - sigma) & (vel < center + sigma)
+    score = jnp.where(mask, col, -jnp.inf)
+    return vel[jnp.argmax(score)]
+
+
+def extract_ridge(freq: np.ndarray, vel: np.ndarray, fv_map: jnp.ndarray,
+                  ref_freq_idx: Optional[int] = None, sigma: float = 25.0,
+                  vel_max: float = 400.0,
+                  ref_vel: Optional[Callable] = None,
+                  sg_window: int = 25, sg_order: int = 2) -> jnp.ndarray:
+    """Extract the ridge curve (len(freq),) from ``fv_map`` (nvel, nfreq)."""
+    freq = np.asarray(freq)
+    vel_rev = np.asarray(vel)[::-1]
+    fv = fv_map[::-1, :]                                  # match reversed vel
+
+    if ref_freq_idx is None and ref_vel is None:
+        max_idx = int(np.abs(vel_max - vel_rev).argmin())
+        sub_vel = jnp.asarray(vel_rev[max_idx:].copy())
+        return sub_vel[jnp.argmax(fv[max_idx:], axis=0)]
+
+    vel_j = jnp.asarray(vel_rev.copy())
+    if ref_vel is not None:
+        centers = jnp.asarray(ref_vel(freq))
+        picked = jax.vmap(lambda col, c: _masked_argmax_vel(col, vel_j, c, sigma),
+                          in_axes=(1, 0))(fv, centers)
+    else:
+        nf = freq.shape[0]
+        v0 = vel_j[jnp.argmax(fv[:, ref_freq_idx])]
+
+        def walk(cols):
+            def step(prev, col):
+                v = _masked_argmax_vel(col, vel_j, prev, sigma)
+                return v, v
+            _, picks = jax.lax.scan(step, v0, cols)
+            return picks
+
+        back = walk(jnp.flip(fv[:, :ref_freq_idx], axis=1).T)  # ref-1 ... 0
+        fwd = walk(fv[:, ref_freq_idx + 1:].T)                 # ref+1 ... nf-1
+        picked = jnp.concatenate([jnp.flip(back), jnp.asarray([v0]), fwd])
+        assert picked.shape[0] == nf
+    return savgol_filter(picked[None, :], sg_window, sg_order, axis=-1)[0]

@@ -1,0 +1,35 @@
+"""Executable numpy specification of ridge extraction
+(reference modules/utils.py:621-678 extract_ridge_ref_idx)."""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy.signal import savgol_filter
+
+
+def ref_extract_ridge(freq, vel, fv_map, ref_freq_idx=None, sigma=25,
+                      vel_max=400, ref_vel=None):
+    vel = np.asarray(vel)[::-1]
+    fv_map = np.asarray(fv_map)[::-1, :]
+
+    if ref_freq_idx is None and ref_vel is None:
+        max_idx = int(np.abs(vel_max - vel).argmin())
+        v = vel[max_idx:]
+        return v[np.argmax(fv_map[max_idx:], axis=0)]
+
+    nf = len(freq)
+    out = np.zeros(nf)
+    if ref_vel is None:
+        out[ref_freq_idx] = vel[np.argmax(fv_map[:, ref_freq_idx])]
+        for i in range(ref_freq_idx - 1, -1, -1):
+            mask = (vel > out[i + 1] - sigma) & (vel < out[i + 1] + sigma)
+            out[i] = vel[mask][np.argmax(fv_map[mask, i])]
+        for i in range(ref_freq_idx + 1, nf):
+            mask = (vel > out[i - 1] - sigma) & (vel < out[i - 1] + sigma)
+            out[i] = vel[mask][np.argmax(fv_map[mask, i])]
+    else:
+        centers = ref_vel(np.asarray(freq))
+        for i in range(nf):
+            mask = (vel > centers[i] - sigma) & (vel < centers[i] + sigma)
+            out[i] = vel[mask][np.argmax(fv_map[mask, i])]
+    return savgol_filter(out, 25, 2)

@@ -1,0 +1,11 @@
+"""Pipeline orchestration: preprocessing stages, per-chunk imaging, batch
+workflows with checkpoint/resume, and the CLI.
+
+Replaces the reference's eager compute-in-constructor orchestration
+(apis/timeLapseImaging.py, apis/imaging_workflow.py) with explicit staged
+pure functions around jit boundaries.
+"""
+
+from das_diff_veh_tpu.pipeline.preprocess import (  # noqa: F401
+    preprocess_for_surface_waves, preprocess_for_tracking, channels_to_distance)
+from das_diff_veh_tpu.pipeline.timelapse import ChunkResult, process_chunk  # noqa: F401

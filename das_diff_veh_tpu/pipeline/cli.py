@@ -1,0 +1,43 @@
+"""Command-line batch imaging (reference apis/imaging_workflow.py:206-223).
+
+    python -m das_diff_veh_tpu.pipeline.cli --data_root /data \
+        --start_date 20230301 --end_date 20230307 --x0 700 --method xcorr
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from das_diff_veh_tpu.config import ImagingConfig, PipelineConfig
+from das_diff_veh_tpu.pipeline.workflow import run_date_range
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Vehicle-DAS time-lapse imaging")
+    p.add_argument("--data_root", required=True, help="root with per-date npz folders")
+    p.add_argument("--start_date", required=True, help="YYYYMMDD")
+    p.add_argument("--end_date", required=True, help="YYYYMMDD")
+    p.add_argument("--out_dir", default="results")
+    p.add_argument("--method", default="xcorr", choices=["xcorr", "surface_wave"])
+    p.add_argument("--x0", type=float, default=700.0, help="pivot along fiber [m]")
+    p.add_argument("--n_min_save", type=float, default=60.0,
+                   help="checkpoint the running average every N data-minutes")
+    p.add_argument("--verbal", action="store_true", help="per-chunk progress logs")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO if args.verbal else logging.WARNING,
+                        format="%(asctime)s %(name)s %(message)s")
+    cfg = PipelineConfig().replace(imaging=ImagingConfig(x0=args.x0))
+    summary = run_date_range(args.data_root, args.start_date, args.end_date,
+                             cfg=cfg, method=args.method, out_dir=args.out_dir,
+                             n_min_save=args.n_min_save)
+    print(summary)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,79 @@
+"""Preprocessing stages feeding the tracker and the imaging kernels.
+
+Mirrors the reference's two preprocessing paths
+(apis/timeLapseImaging.py:51-102) as pure jit-able functions:
+
+- *surface-wave band*: 1.2-30 Hz bandpass, empty/noisy trace imputation,
+  optional per-trace L2 norm;
+- *quasi-static band (tracking)*: loud-channel kill, imputation, 0.08-1 Hz
+  bandpass, 250->50 Hz temporal subsample, 8.16 m -> 1 m polyphase spatial
+  resample, spatial wavenumber bandpass.
+
+Deliberate delta: the reference imputes exactly ONE trace per call via
+``argmax`` of the QC mask (modules/utils.py:316-329 — and imputes channel 0
+when nothing matches); here every flagged trace is imputed by its neighbor
+average and nothing is touched when the mask is empty (SURVEY.md §7 step 2).
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+
+import numpy as np
+import jax.numpy as jnp
+
+from das_diff_veh_tpu.config import (InterrogatorConfig,
+                                     SurfaceWavePreprocessConfig,
+                                     TrackingPreprocessConfig)
+from das_diff_veh_tpu.ops.filters import (bandpass_space, bandpass_time,
+                                          l2_normalize_traces)
+from das_diff_veh_tpu.ops.qc import empty_trace_mask, impute_traces, noisy_trace_mask
+from das_diff_veh_tpu.ops.resample import resample_poly
+
+
+def channels_to_distance(x: np.ndarray,
+                         interrogator: InterrogatorConfig = InterrogatorConfig()) -> np.ndarray:
+    """Channel numbers -> meters along fiber (reference
+    apis/timeLapseImaging.py:42: ``(x - start_ch) * dx``)."""
+    return (np.asarray(x) - interrogator.start_ch) * interrogator.dx
+
+
+def preprocess_for_surface_waves(data: jnp.ndarray, dt: float,
+                                 cfg: SurfaceWavePreprocessConfig = SurfaceWavePreprocessConfig(),
+                                 normalize: bool | None = None) -> jnp.ndarray:
+    """Surface-wave band conditioning (reference
+    apis/timeLapseImaging.py:51-71).  ``normalize`` overrides
+    ``cfg.normalize_traces`` (the reference normalizes for the direct
+    dispersion method but not the xcorr method)."""
+    out = bandpass_time(data, dt, cfg.flo, cfg.fhi)
+    if cfg.impute_empty:
+        out = impute_traces(out, empty_trace_mask(out, cfg.noise_threshold))
+    if cfg.impute_noisy:
+        out = impute_traces(out, noisy_trace_mask(out, cfg.noise_threshold))
+    norm = cfg.normalize_traces if normalize is None else normalize
+    if norm:
+        out = l2_normalize_traces(out)
+    return out
+
+
+def preprocess_for_tracking(data: jnp.ndarray, x_dist: np.ndarray, dt: float,
+                            cfg: TrackingPreprocessConfig = TrackingPreprocessConfig(),
+                            dx: float = 8.16):
+    """Quasi-static band conditioning for the tracker (reference
+    apis/timeLapseImaging.py:74-102).
+
+    Returns ``(track_data (n_track_ch, n_track_t), x_track (meters, ~1 m
+    grid), t_stride)``; the caller slices its time axis with ``t_stride``.
+    """
+    # zero out loud channels, impute dead ones
+    loud = jnp.median(jnp.abs(data), axis=-1) > cfg.noise_level
+    out = jnp.where(loud[:, None], 0.0, data)
+    out = impute_traces(out, empty_trace_mask(out, cfg.empty_threshold))
+    out = bandpass_time(out, dt, cfg.flo, cfg.fhi)
+    out = out[:, ::cfg.subsample]
+    # spatial resample dx -> target_dx (8.16 m -> 1 m is 204/25)
+    frac = Fraction(dx / cfg.target_dx).limit_denominator(1000)
+    out = resample_poly(out, frac.numerator, frac.denominator, axis=0)
+    x_track = np.arange(out.shape[0]) * cfg.target_dx + float(np.asarray(x_dist)[0])
+    out = bandpass_space(out, cfg.target_dx, cfg.flo_space, cfg.fhi_space)
+    return out, x_track, cfg.subsample

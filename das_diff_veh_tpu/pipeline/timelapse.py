@@ -1,0 +1,128 @@
+"""Per-chunk processing: one DAS time window -> tracked vehicles -> selected
+surface-wave windows -> stacked dispersion image (and/or VSG stack).
+
+The reference's TimeLapseImaging object (apis/timeLapseImaging.py:22-197)
+re-cast as a pure staged function: every stage is an explicit call, all
+heavy compute sits behind jit, and the result is an inert pytree.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from das_diff_veh_tpu.config import PipelineConfig
+from das_diff_veh_tpu.core.section import DasSection, VehicleTracks, WindowBatch
+from das_diff_veh_tpu.models import vsg as V
+from das_diff_veh_tpu.models.tracking import track_section
+from das_diff_veh_tpu.models.windows import (mute_along_time, select_windows,
+                                             traj_mute_mask)
+from das_diff_veh_tpu.pipeline.preprocess import (channels_to_distance,
+                                                  preprocess_for_surface_waves,
+                                                  preprocess_for_tracking)
+
+
+@dataclass
+class ChunkResult:
+    """One processed chunk: stacked image + provenance."""
+
+    disp_image: jnp.ndarray          # (nvel, nfreq)
+    vsg_stack: Optional[jnp.ndarray]  # (nch_out, wlen) for method='xcorr'
+    n_windows: int                   # accepted (isolated) vehicle windows
+    tracks: VehicleTracks
+    batch: WindowBatch               # surface-wave-band windows
+    qs_batch: WindowBatch            # raw-band windows (quasi-static weights)
+
+
+def disp_image_batch(batch: WindowBatch, cfg: PipelineConfig) -> jnp.ndarray:
+    """Direct per-window dispersion images with muting (reference
+    DispersionImagesFromWindows + SurfaceWaveDispersion 'naive' over
+    [disp_start_x+x0, x0], apis/imaging_classes.py:96-107 +
+    apis/dispersion_classes.py:24-32): mute along the trajectory, slant the
+    muted window over the imaging offset range.  Returns (max_windows, nvel,
+    nfreq)."""
+    dcfg = cfg.dispersion
+    dx = cfg.interrogator.dx
+    x = np.asarray(batch.x)
+    start_x = cfg.imaging.x0 + cfg.imaging.disp_start_x
+    sxi = int(np.argmax(x >= start_x))
+    nx = int((cfg.imaging.disp_end_x - cfg.imaging.disp_start_x) / dx)
+    freqs = jnp.arange(dcfg.freq_min, dcfg.freq_max, dcfg.freq_step)
+    vels = jnp.arange(dcfg.vel_min, dcfg.vel_max, dcfg.vel_step)
+    dt = float(batch.t[0, 1] - batch.t[0, 0])
+
+    from das_diff_veh_tpu.ops.dispersion import fv_map_fk
+
+    def one(args):
+        data, t, tx, tt = args
+        mask = traj_mute_mask(batch.x, t, tx, tt, jnp.isfinite(tt), dx,
+                              offset=cfg.mute.offset, alpha=cfg.mute.alpha,
+                              delta_x=cfg.mute.delta_x)
+        muted = data * mask
+        return fv_map_fk(muted[sxi:sxi + nx], dx, dt, freqs, vels,
+                         norm=dcfg.norm, sg_window=dcfg.sg_window,
+                         sg_order=dcfg.sg_order)
+
+    # lax.map (not vmap): the per-window transform is gather-heavy and a
+    # 64-way batched program segfaults the XLA CPU compiler; the mapped body
+    # compiles once and loops
+    return jax.lax.map(one, (batch.data, batch.t, batch.traj_x, batch.traj_t))
+
+
+def process_chunk(section: DasSection, cfg: PipelineConfig = PipelineConfig(),
+                  method: str = "xcorr", x_is_channels: bool = False) -> ChunkResult:
+    """Full per-chunk pipeline (reference TimeLapseImaging usage in
+    apis/imaging_workflow.py:50-67): preprocess both bands, track, select
+    windows around cfg.imaging.x0, and build the method's stacked image.
+
+    ``method``: 'xcorr' (virtual shot gathers -> dispersion of the stack) or
+    'surface_wave' (muted direct dispersion per window, averaged).
+    """
+    assert method in {"xcorr", "surface_wave"}
+    x_dist = (channels_to_distance(section.x, cfg.interrogator)
+              if x_is_channels else np.asarray(section.x))
+    t = np.asarray(section.t)
+    dt = float(t[1] - t[0])
+    data = jnp.asarray(section.data)
+
+    # --- both preprocessing bands --------------------------------------------
+    d_sw = preprocess_for_surface_waves(data, dt, cfg.sw_preprocess,
+                                        normalize=(method == "surface_wave"))
+    d_track, x_track, t_stride = preprocess_for_tracking(
+        data, x_dist, dt, cfg.tracking_preprocess, dx=cfg.interrogator.dx)
+    t_track = t[::t_stride]
+
+    # --- track (amplitude negated: deflection pulses become positive peaks,
+    #     reference apis/timeLapseImaging.py:108-109) --------------------------
+    tracks = track_section(-d_track, x_track, t_track,
+                           cfg.imaging.start_x, cfg.imaging.end_x,
+                           cfg.tracking, cfg.track_qc)
+
+    # --- select windows: filtered band + raw band (quasi-static weights),
+    #     reference select_surface_wave_windows (:166-192) ---------------------
+    batch = select_windows(d_sw, x_dist, t, tracks, cfg.imaging.x0, cfg.window)
+    qs_batch = select_windows(data, x_dist, t, tracks, cfg.imaging.x0, cfg.window)
+
+    n_windows = int(jnp.sum(batch.valid))
+    if method == "xcorr":
+        g = V.VsgGeometry.build(np.asarray(batch.x), dt, cfg.imaging.x0,
+                                cfg.imaging.x0 + cfg.imaging.disp_start_x,
+                                cfg.imaging.x0 + 75.0, cfg.gather)
+        gathers = V.build_gather_batch(batch, g, cfg.gather)
+        stack = V.stack_gathers(gathers, batch.valid)
+        img = V.gather_disp_image(stack, g.offsets(np.asarray(batch.x)), dt,
+                                  cfg.interrogator.dx, cfg.dispersion,
+                                  cfg.imaging.disp_start_x, cfg.imaging.disp_end_x)
+        vsg_stack = stack
+    else:
+        imgs = disp_image_batch(batch, cfg)
+        img = V.stack_gathers(imgs, batch.valid)
+        vsg_stack = None
+
+    return ChunkResult(disp_image=img, vsg_stack=vsg_stack,
+                       n_windows=n_windows, tracks=tracks,
+                       batch=batch, qs_batch=qs_batch)

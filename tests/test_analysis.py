@@ -1,0 +1,126 @@
+import numpy as np
+import jax.numpy as jnp
+import pytest
+from scipy.interpolate import interp1d
+
+from das_diff_veh_tpu.analysis import (bootstrap_disp, classify_by_speed,
+                                       classify_by_weight, convergence_test,
+                                       extract_ridge, majority_speed_mask,
+                                       majority_weight_mask,
+                                       quasi_static_peaks, sample_indices,
+                                       vehicle_speeds)
+from das_diff_veh_tpu.config import BootstrapConfig, DispersionConfig
+from das_diff_veh_tpu.core.section import VehicleTracks, WindowBatch
+from das_diff_veh_tpu.models.vsg import gather_disp_image
+from das_diff_veh_tpu.oracle.ridge_ref import ref_extract_ridge
+
+RNG = np.random.default_rng(17)
+
+
+def _fv_map(nvel=400, nfreq=120):
+    """Smooth dispersion-like map: one bright dispersive ridge + texture."""
+    vels = np.arange(200.0, 200.0 + nvel)
+    freqs = np.linspace(2.0, 20.0, nfreq)
+    ridge = 500.0 - 8.0 * (freqs - 2.0)
+    fv = np.exp(-0.5 * ((vels[:, None] - ridge[None, :]) / 40.0) ** 2)
+    fv += 0.1 * RNG.random((nvel, nfreq))
+    return freqs, vels, fv
+
+
+@pytest.mark.parametrize("mode", ["none", "ref_idx", "ref_vel"])
+def test_extract_ridge_matches_reference(mode):
+    freqs, vels, fv = _fv_map()
+    kw = {}
+    if mode == "none":
+        kw = dict(vel_max=520.0)
+    elif mode == "ref_idx":
+        kw = dict(ref_freq_idx=60, sigma=30.0)
+    else:
+        kw = dict(ref_vel=lambda f: 500.0 - 8.0 * (f - 2.0), sigma=30.0)
+    ref = ref_extract_ridge(freqs, vels, fv, **kw)
+    ours = np.asarray(extract_ridge(freqs, vels, jnp.asarray(fv), **kw))
+    np.testing.assert_allclose(ours, ref, rtol=1e-9, atol=1e-9)
+
+
+def test_vehicle_speeds_from_linear_tracks():
+    x = np.arange(300.0)
+    t_track = np.arange(4000) * 0.02
+    speeds_true = np.array([12.0, 18.0])
+    t_idx = np.stack([(5.0 + x / s) / 0.02 for s in speeds_true])
+    tracks = VehicleTracks(t_idx=jnp.asarray(t_idx), valid=jnp.ones(2, bool),
+                           x=jnp.asarray(x), t=jnp.asarray(t_track))
+    got = np.asarray(vehicle_speeds(tracks))
+    np.testing.assert_allclose(got, speeds_true, rtol=1e-6)
+
+
+def test_quasi_static_peaks_scale_with_weight():
+    nt, nx = 2000, 30
+    t = np.arange(nt) * 0.004
+    pulse = np.exp(-0.5 * ((t - 4.0) / 0.8) ** 2)
+    def batch_for(amp):
+        data = np.tile(-amp * pulse, (nx, 1))[None]
+        return WindowBatch(data=jnp.asarray(data), x=jnp.zeros(nx),
+                           t=jnp.asarray(t[None]), traj_x=jnp.zeros((1, 4)),
+                           traj_t=jnp.zeros((1, 4)),
+                           valid=jnp.ones(1, bool))
+    p1 = float(quasi_static_peaks(batch_for(1.0))[0])
+    p2 = float(quasi_static_peaks(batch_for(2.5))[0])
+    assert p2 > 2.0 * p1 > 0
+
+
+def test_classification_masks():
+    speeds = np.concatenate([RNG.normal(15, 1, 200), [30.0, 31.0], [5.0]])
+    fast, mid, slow = classify_by_speed(speeds)
+    assert fast.sum() >= 2 and slow.sum() >= 1
+    assert not (fast & mid).any() and not (mid & slow).any()
+    assert majority_speed_mask(speeds).sum() > 150
+
+    peaks = np.concatenate([RNG.normal(0.8, 0.05, 300), RNG.uniform(1.3, 3.0, 20)])
+    heavy, midw, light = classify_by_weight(peaks)
+    assert heavy.sum() == 20
+    assert (heavy | midw | light).sum() == peaks.size
+    assert majority_weight_mask(peaks).sum() > 100
+
+
+def test_sample_indices_excludes_first():
+    idx = sample_indices(50, 10, 20, np.random.default_rng(0))
+    assert idx.shape == (20, 10)
+    assert idx.min() >= 1
+    for row in idx:
+        assert len(set(row.tolist())) == 10
+
+
+def test_bootstrap_disp_matches_direct_stack():
+    """A single repetition must equal stacking those windows directly."""
+    nwin, nch, wlen = 8, 20, 250
+    gathers = jnp.asarray(RNG.standard_normal((nwin, nch, wlen)))
+    offsets = (np.arange(nch) - nch + 1) * 8.16
+    dcfg = DispersionConfig(freq_step=0.5, vel_step=10.0)
+    cfg = BootstrapConfig(bt_times=1, bt_size=3, sigma=(30.0,),
+                          ref_freq_idx=(10,), freq_lb=(3.0,), freq_ub=(16.0,))
+    idx = np.array([[1, 4, 6]])
+    ridges, freqs = bootstrap_disp(gathers, offsets, 0.004, 8.16, idx,
+                                   cfg, dcfg)
+    stack = jnp.mean(gathers[jnp.asarray(idx[0])], axis=0)
+    img = gather_disp_image(stack, offsets, 0.004, 8.16, dcfg, -150.0, 0.0)
+    band = (freqs >= 3.0) & (freqs < 16.0)
+    vels = np.arange(dcfg.vel_min, dcfg.vel_max, dcfg.vel_step)
+    ref_idx = int(10 - np.sum(freqs < 3.0))
+    expect = np.asarray(extract_ridge(freqs[band], vels,
+                                      img[:, jnp.asarray(band)],
+                                      ref_freq_idx=ref_idx, sigma=30.0,
+                                      vel_max=cfg.vel_max))
+    np.testing.assert_allclose(ridges[0][0], expect, rtol=1e-9, atol=1e-9)
+
+
+def test_convergence_test_shape():
+    nwin, nch, wlen = 10, 16, 200
+    gathers = jnp.asarray(RNG.standard_normal((nwin, nch, wlen)))
+    offsets = (np.arange(nch) - nch + 1) * 8.16
+    dcfg = DispersionConfig(freq_step=0.25, vel_step=25.0)
+    cfg = BootstrapConfig(bt_times=3, sigma=(50.0,), ref_freq_idx=(12,),
+                          freq_lb=(3.0,), freq_ub=(12.0,))
+    out = convergence_test(gathers, offsets, 0.004, 8.16, 4, 3,
+                           np.random.default_rng(1), cfg, dcfg)
+    assert out.shape == (1, 4)
+    assert np.isfinite(out).all()
