@@ -1,0 +1,33 @@
+"""Differentiable Rayleigh-wave Vs inversion (SURVEY §7 step 10).
+
+Replaces the reference's external evodcinv (CPSO) + disba (numba surf96)
+stack (inversion_diff_*.ipynb) with a JAX transfer-matrix forward model,
+batched particle-swarm + optax gradient inversion, and jacfwd sensitivity
+kernels.
+"""
+
+from das_diff_veh_tpu.inversion.curves import (Curve, curves_from_ridges,
+                                               load_reference_ridge_npz,
+                                               ridge_stats)
+from das_diff_veh_tpu.inversion.forward import (LayeredModel,
+                                                density_gardner_linear,
+                                                phase_velocity,
+                                                rayleigh_halfspace_velocity,
+                                                secular, vp_from_poisson)
+from das_diff_veh_tpu.inversion.invert import (InversionResult, LayerBounds,
+                                               ModelSpec, invert,
+                                               make_misfit_fn,
+                                               speed_model_spec,
+                                               weight_model_spec)
+from das_diff_veh_tpu.inversion.sensitivity import (SensitivityKernel,
+                                                    phase_sensitivity,
+                                                    resample_fine)
+
+__all__ = [
+    "Curve", "curves_from_ridges", "load_reference_ridge_npz", "ridge_stats",
+    "LayeredModel", "density_gardner_linear", "phase_velocity",
+    "rayleigh_halfspace_velocity", "secular", "vp_from_poisson",
+    "InversionResult", "LayerBounds", "ModelSpec", "invert", "make_misfit_fn",
+    "speed_model_spec", "weight_model_spec",
+    "SensitivityKernel", "phase_sensitivity", "resample_fine",
+]

@@ -1,0 +1,88 @@
+"""Dispersion-curve containers and preparation from bootstrap ridges.
+
+Mirrors the reference's curve-building path: ``plot_disp_curves``
+(/root/reference/modules/utils.py:680-713) computes per-band mean / range /
+std across bootstrap ridge repetitions, and inversion_diff_speed.ipynb
+cell 5 turns those into period-domain ``evodcinv.Curve`` objects (km/s,
+reversed so periods ascend, uncertainties = bootstrap range).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import numpy as np
+
+
+class Curve(NamedTuple):
+    """One observed modal dispersion curve (period-domain, km/s).
+
+    Same fields as ``evodcinv.Curve`` (reference inversion notebooks,
+    cell 5): ``mode`` 0 is fundamental; ``weight`` scales this curve's
+    contribution to the joint misfit; ``uncertainty`` (km/s) normalises
+    residuals (None => 1).
+    """
+
+    period: np.ndarray
+    velocity: np.ndarray
+    mode: int
+    weight: float = 1.0
+    uncertainty: np.ndarray | None = None
+
+
+def ridge_stats(ridge_vels: np.ndarray):
+    """(mean, range, std) over bootstrap repetitions, shape (nf,) each.
+
+    The non-plotting core of the reference's ``plot_disp_curves``
+    (modules/utils.py:690-698): mean / (max-min) / std across the
+    ``(n_bootstrap, nf)`` ridge matrix of one frequency band.
+    """
+    v = np.asarray(ridge_vels, dtype=np.float64)
+    return v.mean(axis=0), v.max(axis=0) - v.min(axis=0), v.std(axis=0)
+
+
+def curves_from_ridges(
+    freqs: np.ndarray,
+    freq_lb: Sequence[float],
+    freq_ub: Sequence[float],
+    ridge_vels: Sequence[np.ndarray],
+    band_modes: Sequence[int],
+    weights: Sequence[float] | None = None,
+    skip_bands: Sequence[int] = (),
+) -> list[Curve]:
+    """Build period-domain curves from per-band bootstrap ridges.
+
+    Reference: inversion_diff_speed.ipynb cell 5 - band ``i`` covers
+    ``freq_lb[i] <= f < freq_ub[i]``; velocities m/s -> km/s; arrays are
+    reversed so period ascends; uncertainty = bootstrap range.
+    ``band_modes`` maps each band to its modal order (the reference uses
+    bands 0,2,3 as modes 0,3,4 and skips band 1).
+    """
+    freqs = np.asarray(freqs, dtype=np.float64)
+    weights = list(weights) if weights is not None else [1.0] * len(ridge_vels)
+    curves = []
+    for i, vels in enumerate(ridge_vels):
+        if i in skip_bands:
+            continue
+        mask = (freqs >= freq_lb[i]) & (freqs < freq_ub[i])
+        mean, rng, _ = ridge_stats(vels)
+        periods = (1.0 / freqs[mask])[::-1]
+        curves.append(
+            Curve(
+                period=periods,
+                velocity=mean[::-1] / 1000.0,
+                mode=int(band_modes[i]),
+                weight=float(weights[i]),
+                uncertainty=np.maximum(rng[::-1] / 1000.0, 1e-4),
+            )
+        )
+    return curves
+
+
+def load_reference_ridge_npz(path: str):
+    """Load a ``{x0}_speeds.npz`` / ``{x0}_weights.npz``-layout archive
+    (reference data/700_speeds.npz: ``freqs``, ``freq_lb``, ``freq_ub``,
+    plus per-class ``vels_*`` object arrays of bootstrap ridges)."""
+    d = np.load(path, allow_pickle=True)
+    out = {k: d[k] for k in d.files}
+    return out

@@ -1,0 +1,113 @@
+"""Run the full reference-parity Vs inversions (BASELINE config 5).
+
+Reproduces inversion_diff_speed.ipynb / inversion_diff_weight.ipynb cells
+5-9 on the reference's shipped bootstrap-ridge archives: per vehicle class,
+build modal curves (bands 0/2/3 -> modes 0/3/4), invert with the TPU-batched
+swarm + optax refinement, and report the evodcinv-style weighted RMSE
+(reference best: 0.2210 speed classes / 0.1164 weight classes).
+
+Search runs on the default JAX device (TPU f32 under axon); the final best
+model is re-scored on CPU float64 against the *full-resolution* curves so
+the reported misfit is not a decimated or reduced-precision estimate.
+
+Usage: python scripts/inversion_parity.py [--quick] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+from das_diff_veh_tpu.inversion import (curves_from_ridges,
+                                        load_reference_ridge_npz,
+                                        make_misfit_fn, invert,
+                                        speed_model_spec, weight_model_spec)
+from das_diff_veh_tpu.inversion.curves import Curve
+
+REF_DATA = os.environ.get("DAS_REF_DATA", "/root/reference/data")
+
+# (archive, class key, ModelSpec, band->(mode, weight) rows used)  - from
+# inversion_diff_speed.ipynb cell 5 and inversion_diff_weight.ipynb cell 5.
+CASES = [
+    ("700_speeds.npz", "vels_fast", "speed", [(0, 0, 1.0), (3, 4, 1.0)]),
+    ("700_speeds.npz", "vels_mid", "speed",
+     [(0, 0, 2.0), (2, 3, 1.0), (3, 4, 1.0)]),
+    ("700_speeds.npz", "vels_slow", "speed",
+     [(0, 0, 1.0), (2, 3, 1.0), (3, 4, 1.0)]),
+    ("700_weights.npz", "vels_heavy", "weight",
+     [(0, 0, 2.0), (2, 3, 1.0), (3, 4, 1.0)]),
+    ("700_weights.npz", "vels_mid", "weight",
+     [(0, 0, 2.0), (2, 3, 1.0), (3, 4, 1.0)]),
+    ("700_weights.npz", "vels_light", "weight", [(0, 0, 2.0), (3, 4, 1.0)]),
+]
+
+
+def build_curves(archive: str, key: str, rows, decimate: int = 1):
+    d = load_reference_ridge_npz(os.path.join(REF_DATA, archive))
+    bands = [np.stack([np.asarray(v, dtype=np.float64) for v in d[key][i]])
+             for i in range(len(d[key]))]
+    use = [r[0] for r in rows]
+    curves = curves_from_ridges(
+        d["freqs"], d["freq_lb"], d["freq_ub"], bands,
+        band_modes=[dict((b, m) for b, m, _ in rows).get(i, 0)
+                    for i in range(len(bands))],
+        weights=[dict((b, w) for b, _, w in rows).get(i, 1.0)
+                 for i in range(len(bands))],
+        skip_bands=[i for i in range(len(bands)) if i not in use])
+    if decimate > 1:
+        curves = [Curve(c.period[::decimate], c.velocity[::decimate], c.mode,
+                        c.weight, c.uncertainty[::decimate]) for c in curves]
+    return curves
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default="INVERSION_PARITY.json")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    popsize, maxiter, ref_steps = (24, 40, 40) if args.quick else (50, 300, 150)
+    results = {}
+    for archive, key, spec_name, rows in CASES:
+        spec = speed_model_spec() if spec_name == "speed" else weight_model_spec()
+        dec = build_curves(archive, key, rows, decimate=3)
+        t0 = time.time()
+        res = invert(spec, dec, popsize=popsize, maxiter=maxiter,
+                     n_refine_starts=8, n_refine_steps=ref_steps,
+                     n_grid=300, seed=args.seed)
+        search_t = time.time() - t0
+        # final f64 full-resolution scoring on CPU
+        full = build_curves(archive, key, rows, decimate=1)
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            mf64 = make_misfit_fn(spec, full, n_grid=600)
+            x = jax.device_put(np.asarray(res.x_best, dtype=np.float64), cpu)
+            final = float(mf64(x))
+        name = f"{archive.split('_')[0]}_{key.removeprefix('vels_')}_{spec_name}"
+        results[name] = {
+            "misfit_f64_full": final,
+            "misfit_search": float(res.misfit),
+            "search_seconds": round(search_t, 1),
+            "vs_km_s": np.asarray(res.model.vs).round(4).tolist(),
+            "thickness_m": (np.asarray(res.model.thickness)[:-1]
+                            * 1000).round(1).tolist(),
+        }
+        print(name, json.dumps(results[name]), flush=True)
+
+    results["reference_best"] = {"speed": 0.2210, "weight": 0.1164}
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=1)
+    print("wrote", args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,234 @@
+"""Inversion subsystem tests: forward model against analytic oracles,
+propagator algebra, differentiability, sensitivity kernels, and end-to-end
+profile recovery (SURVEY §7 step 10; reference inversion_diff_*.ipynb)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from das_diff_veh_tpu.inversion import (Curve, LayerBounds, LayeredModel,
+                                        ModelSpec, curves_from_ridges,
+                                        density_gardner_linear, invert,
+                                        make_misfit_fn, phase_sensitivity,
+                                        phase_velocity,
+                                        rayleigh_halfspace_velocity,
+                                        resample_fine, ridge_stats, secular,
+                                        speed_model_spec, vp_from_poisson,
+                                        weight_model_spec)
+from das_diff_veh_tpu.inversion.forward import (_layer_A, _layer_propagator)
+
+
+def _model(d, vs, nu=0.4375):
+    vs = jnp.asarray(vs, dtype=jnp.float64)
+    vp = vp_from_poisson(vs, nu)
+    return LayeredModel(jnp.asarray(d, dtype=jnp.float64), vp, vs,
+                        density_gardner_linear(vp))
+
+
+class TestLayerSystem:
+    def test_coefficient_matrix_eigenvalues(self):
+        # A's spectrum must be +-k*nu_p, +-k*nu_s (evanescent regime).
+        vp_, vs_, rho_ = 1.5, 0.5, 1.8
+        c, k = 0.4, 5.0
+        A = np.asarray(_layer_A(jnp.float64(k), jnp.float64(k * c), vp_, vs_,
+                                rho_))
+        got = np.sort(np.linalg.eigvals(A).real)
+        nup = k * np.sqrt(1 - (c / vp_) ** 2)
+        nus = k * np.sqrt(1 - (c / vs_) ** 2)
+        np.testing.assert_allclose(got, np.sort([-nus, -nup, nup, nus]),
+                                   rtol=1e-12)
+
+    def test_propagator_is_expm(self):
+        # closed-form polynomial expm == scipy expm (up to the e^-s scale)
+        from scipy.linalg import expm
+        rng = np.random.default_rng(1)
+        for _ in range(20):
+            vs_ = rng.uniform(0.2, 1.0)
+            vp_ = 3.0 * vs_
+            rho_ = rng.uniform(1.6, 2.1)
+            c = rng.uniform(0.1, 1.2)
+            k = rng.uniform(1.0, 800.0)
+            d = rng.uniform(0.001, 0.08)
+            A = np.asarray(_layer_A(jnp.float64(k), jnp.float64(k * c), vp_,
+                                    vs_, rho_))
+            M_ref = expm(A * d)
+            M = np.asarray(_layer_propagator(jnp.float64(k),
+                                             jnp.float64(k * c), d, vp_, vs_,
+                                             rho_))
+            M_ref = M_ref / np.abs(M_ref).max()
+            M = M / np.abs(M).max()
+            i = np.unravel_index(np.abs(M_ref).argmax(), M_ref.shape)
+            if np.sign(M_ref[i]) != np.sign(M[i]):
+                M = -M
+            np.testing.assert_allclose(M, M_ref, atol=1e-10)
+
+    def test_propagator_group_property(self):
+        k, om = jnp.float64(5.0), jnp.float64(2.0)
+        args = (1.5, 0.5, 1.8)
+        M1 = _layer_propagator(k, om, 0.013, *args)
+        M2 = _layer_propagator(k, om, 0.027, *args)
+        M3 = _layer_propagator(k, om, 0.040, *args)
+        # scaled propagators multiply up to a positive factor
+        P = np.asarray(M2 @ M1)
+        Q = np.asarray(M3)
+        np.testing.assert_allclose(P / np.abs(P).max(), Q / np.abs(Q).max(),
+                                   atol=1e-12)
+
+
+class TestPhaseVelocity:
+    def test_homogeneous_halfspace_matches_rayleigh_root(self):
+        m = _model([0.01, 0.02, 0.0], [0.5, 0.5, 0.5])
+        c = phase_velocity(jnp.array([0.05, 0.1, 0.3, 1.0]), m, mode=0)
+        cr = rayleigh_halfspace_velocity(float(m.vp[0]), 0.5)
+        np.testing.assert_allclose(np.asarray(c), cr, rtol=1e-8)
+
+    def test_two_layer_limits(self):
+        m = _model([0.01, 0.0], [0.2, 0.6])
+        c = phase_velocity(jnp.array([0.01, 2.0]), m, mode=0)
+        c_top = rayleigh_halfspace_velocity(float(m.vp[0]), 0.2)
+        c_half = rayleigh_halfspace_velocity(float(m.vp[1]), 0.6)
+        assert abs(float(c[0]) - c_top) < 2e-3    # high f -> top layer
+        assert abs(float(c[1]) - c_half) < 2e-2   # low f -> halfspace
+
+    def test_normal_dispersion_monotone(self):
+        m = _model([0.008, 0.02, 0.0], [0.2, 0.4, 0.7])
+        c = np.asarray(phase_velocity(jnp.linspace(0.03, 0.5, 20), m, mode=0))
+        assert np.all(np.diff(c) > -1e-9)  # c grows with period
+
+    def test_matches_brute_force_roots_all_modes(self):
+        from scipy.optimize import brentq
+        model = speed_model_spec().to_model(jnp.full(12, 0.5))
+        lo = 0.7 * float(model.vs.min())
+        hi = 0.999 * float(model.vs[-1])
+        for mode, T in [(0, 0.2), (0, 0.08), (1, 0.1), (3, 0.069),
+                        (4, 0.055)]:
+            om = 2 * np.pi / T
+            cs = np.linspace(lo, hi, 4000)
+            Ds = np.asarray(jax.vmap(
+                lambda c: secular(c, om, model))(jnp.asarray(cs)))
+            flips = np.where(np.sign(Ds[:-1]) * np.sign(Ds[1:]) < 0)[0]
+            roots = [brentq(lambda c: float(secular(c, om, model)),
+                            cs[i], cs[i + 1]) for i in flips]
+            mine = float(phase_velocity(jnp.asarray([T]), model, mode=mode,
+                                        n_grid=300)[0])
+            assert abs(mine - roots[mode]) < 1e-5
+
+    def test_overtone_cutoff_is_nan(self):
+        m = _model([0.01, 0.0], [0.2, 0.6])
+        c = phase_velocity(jnp.array([1.0]), m, mode=4)
+        assert np.isnan(np.asarray(c)).all()
+
+    def test_gradient_matches_finite_differences(self):
+        d = jnp.array([0.008, 0.015, 0.0])
+        vs = jnp.array([0.25, 0.45, 0.75])
+        rho = jnp.full(3, 1.9)
+
+        def cv(vs_):
+            mm = LayeredModel(d, 3.0 * vs_, vs_, rho)
+            return phase_velocity(jnp.array([0.12]), mm, mode=0)[0]
+
+        g = np.asarray(jax.grad(cv)(vs))
+        fd = [(cv(vs + jnp.eye(3)[i] * 1e-6)
+               - cv(vs - jnp.eye(3)[i] * 1e-6)) / 2e-6 for i in range(3)]
+        np.testing.assert_allclose(g, np.asarray(fd), atol=1e-5)
+
+    def test_float32_agrees_with_float64(self):
+        m64 = _model([0.008, 0.02, 0.0], [0.2, 0.4, 0.7])
+        m32 = jax.tree.map(lambda a: a.astype(jnp.float32), m64)
+        c64 = np.asarray(phase_velocity(jnp.linspace(0.05, 0.4, 8), m64))
+        c32 = np.asarray(phase_velocity(
+            jnp.linspace(0.05, 0.4, 8, dtype=jnp.float32), m32))
+        np.testing.assert_allclose(c32, c64, rtol=2e-4)
+
+
+class TestSensitivity:
+    def test_kernel_depth_ordering_and_positivity(self):
+        m = _model([0.01, 0.03, 0.0], [0.25, 0.45, 0.8])
+        k_hi = phase_sensitivity(m, period=1 / 15.0, dz=0.005, zmax=0.12)
+        k_lo = phase_sensitivity(m, period=1 / 4.0, dz=0.005, zmax=0.12)
+        assert np.isfinite(k_hi.kernel).all() and np.isfinite(k_lo.kernel).all()
+        assert k_hi.kernel.sum() > 0 and k_lo.kernel.sum() > 0
+        # centroid of |kernel| is deeper for the lower frequency
+        z = k_hi.depth[:-1]
+        cen = lambda k: float((z * np.abs(k.kernel[:-1])).sum()
+                              / np.abs(k.kernel[:-1]).sum())
+        assert cen(k_lo) > cen(k_hi)
+
+    def test_fine_resampling_preserves_dispersion(self):
+        m = _model([0.01, 0.03, 0.0], [0.25, 0.45, 0.8])
+        fine = resample_fine(m, dz=0.002, zmax=0.1)
+        T = jnp.array([0.08, 0.2])
+        c_coarse = np.asarray(phase_velocity(T, m))
+        c_fine = np.asarray(phase_velocity(T, fine))
+        np.testing.assert_allclose(c_fine, c_coarse, rtol=1e-6)
+
+
+class TestInvert:
+    def test_recovers_synthetic_three_layer_profile(self):
+        vs_true = [0.20, 0.40, 0.70]
+        true = _model([0.006, 0.02, 0.0], vs_true)
+        T0 = jnp.linspace(0.05, 0.4, 12)
+        c0 = phase_velocity(T0, true, mode=0)
+        T1 = jnp.linspace(0.04, 0.1, 6)
+        c1 = phase_velocity(T1, true, mode=1)
+        curves = [
+            Curve(np.asarray(T0), np.asarray(c0), 0, 1.0,
+                  0.01 * np.ones(12)),
+            Curve(np.asarray(T1), np.asarray(c1), 1, 1.0, 0.01 * np.ones(6)),
+        ]
+        spec = ModelSpec(layers=(
+            LayerBounds((0.002, 0.012), (0.1, 0.3)),
+            LayerBounds((0.01, 0.04), (0.25, 0.55)),
+            LayerBounds((0.02, 0.08), (0.5, 1.0)),
+        ))
+        res = invert(spec, curves, popsize=16, maxiter=25,
+                     n_refine_starts=3, n_refine_steps=40, n_grid=250,
+                     seed=0)
+        assert float(res.misfit) < 0.5  # well under 1 sigma per point
+        np.testing.assert_allclose(np.asarray(res.model.vs), vs_true,
+                                   rtol=0.05)
+
+    def test_misfit_penalises_missing_overtone(self):
+        # a curve demanding mode 4 at very long period (below cutoff)
+        spec = ModelSpec(layers=(LayerBounds((0.002, 0.012), (0.1, 0.3)),
+                                 LayerBounds((0.02, 0.08), (0.5, 1.0))))
+        curves = [Curve(np.array([2.0]), np.array([0.6]), 4, 1.0,
+                        np.array([0.01]))]
+        mf = make_misfit_fn(spec, curves, n_grid=200)
+        v = float(mf(jnp.full(4, 0.5)))
+        assert np.isfinite(v) and v >= 4.9  # INVALID_RESIDUAL floor
+
+    def test_weight_spec_free_poisson_param_count(self):
+        assert speed_model_spec().n_params == 12
+        assert weight_model_spec().n_params == 18
+        m = weight_model_spec().to_model(jnp.full(18, 0.5))
+        # nu=0.41 midpoint => vp/vs = sqrt(2*0.59/0.18)
+        np.testing.assert_allclose(np.asarray(m.vp / m.vs),
+                                   np.sqrt(2 * (1 - 0.41) / (1 - 0.82)),
+                                   rtol=1e-12)
+
+
+class TestCurvePrep:
+    def test_ridge_stats_and_band_selection(self):
+        freqs = np.linspace(1.0, 10.0, 10)
+        boot = np.stack([np.full(4, 300.0), np.full(4, 320.0),
+                         np.full(4, 310.0)])
+        mean, rng, std = ridge_stats(boot)
+        np.testing.assert_allclose(mean, 310.0)
+        np.testing.assert_allclose(rng, 20.0)
+        curves = curves_from_ridges(freqs, [3.0], [7.0], [boot], [0], [2.0])
+        (c,) = curves
+        assert c.mode == 0 and c.weight == 2.0
+        # band is 3<=f<7 -> freqs 3,4,5,6; periods ascend
+        np.testing.assert_allclose(c.period, 1.0 / freqs[2:6][::-1])
+        np.testing.assert_allclose(c.velocity, 0.310)
+        np.testing.assert_allclose(c.uncertainty, 0.020)
+
+    def test_reference_layout_roundtrip(self, tmp_path):
+        p = tmp_path / "x.npz"
+        np.savez(p, freqs=np.arange(5.0), freq_lb=np.array([1.0]),
+                 freq_ub=np.array([3.0]))
+        from das_diff_veh_tpu.inversion import load_reference_ridge_npz
+        d = load_reference_ridge_npz(str(p))
+        assert set(d) == {"freqs", "freq_lb", "freq_ub"}
