@@ -8,10 +8,12 @@ vehicle-induced surface waves on DAS fiber):
 - Kalman-filter vehicle tracking (``lax.scan`` over channels)
 - Surface-wave window selection + trajectory-aware muting (static-shape batches)
 - Virtual-shot-gather interferometry (batched circular FFT cross-correlation)
-- Phase-velocity (f-v) dispersion imaging (fk bilinear sampling + slant stack)
+- Phase-velocity (f-v) dispersion imaging (fk bilinear sampling + phase-shift
+  slant stack, selectable via ``DispersionConfig.method``)
 - Vehicle speed/weight classification and bootstrap dispersion uncertainty
-- Differentiable Rayleigh-wave forward model + optax/CPSO Vs inversion
-- Multi-device sharding over ``jax.sharding.Mesh`` (windows, channels, particles)
+- Differentiable Rayleigh-wave forward model + optax/PSO Vs inversion
+- Multi-device sharding of the window axis over ``jax.sharding.Mesh``
+  (``parallel/``) for the time-lapse stacking path
 
 All compute kernels are pure functions over pytrees; a NumPy/SciPy oracle
 (``das_diff_veh_tpu.oracle``) mirrors the reference semantics for equivalence

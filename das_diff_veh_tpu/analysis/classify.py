@@ -66,27 +66,35 @@ def _hist_mode(values: np.ndarray, bins: int = 100) -> float:
 def majority_weight_mask(peaks: np.ndarray, frac_sigma: float = 0.3,
                          bins: int = 100) -> np.ndarray:
     """Keep the majority-weight population: peaks within ±frac_sigma·std of
-    the histogram mode (imaging_diff_speed.ipynb cell 6)."""
+    the histogram mode (imaging_diff_speed.ipynb cell 6).  Empty/all-NaN
+    input yields an all-False mask (no vehicles -> no majority class)."""
     peaks = np.asarray(peaks)
     ok = np.isfinite(peaks)
+    if not ok.any():
+        return ok
     mode = _hist_mode(peaks[ok], bins)
     sigma = float(np.std(peaks[ok]))
     return ok & (peaks >= mode - frac_sigma * sigma) & (peaks <= mode + frac_sigma * sigma)
 
 
 def majority_speed_mask(speeds: np.ndarray, n_sigma: float = 1.0) -> np.ndarray:
-    """Keep speeds within mean ± n_sigma·std (imaging_diff_weight.ipynb cell 5)."""
+    """Keep speeds within mean ± n_sigma·std (imaging_diff_weight.ipynb
+    cell 5).  Empty/all-NaN input yields an all-False mask."""
     speeds = np.asarray(speeds)
     ok = np.isfinite(speeds)
+    if not ok.any():
+        return ok
     mu, sd = float(np.mean(speeds[ok])), float(np.std(speeds[ok]))
     return ok & (speeds >= mu - n_sigma * sd) & (speeds <= mu + n_sigma * sd)
 
 
 def classify_by_speed(speeds: np.ndarray):
     """fast / mid / slow at mean ± std (imaging_diff_speed.ipynb cell 8).
-    Returns three boolean masks."""
+    Returns three boolean masks (all-False on empty/all-NaN input)."""
     speeds = np.asarray(speeds)
     ok = np.isfinite(speeds)
+    if not ok.any():
+        return ok, ok.copy(), ok.copy()
     hi = float(np.mean(speeds[ok]) + np.std(speeds[ok]))
     lo = float(np.mean(speeds[ok]) - np.std(speeds[ok]))
     fast = ok & (speeds > hi)
@@ -98,9 +106,12 @@ def classify_by_speed(speeds: np.ndarray):
 def classify_by_weight(peaks: np.ndarray, heavy_threshold: float = 1.2,
                        bins: int = 100):
     """heavy / mid / light: > 1.2, (mode, 1.2], <= histogram mode
-    (imaging_diff_weight.ipynb cell 8).  Returns three boolean masks."""
+    (imaging_diff_weight.ipynb cell 8).  Returns three boolean masks
+    (all-False on empty/all-NaN input)."""
     peaks = np.asarray(peaks)
     ok = np.isfinite(peaks)
+    if not ok.any():
+        return ok, ok.copy(), ok.copy()
     mode = _hist_mode(peaks[ok], bins)
     heavy = ok & (peaks > heavy_threshold)
     mid = ok & (peaks <= heavy_threshold) & (peaks > mode)

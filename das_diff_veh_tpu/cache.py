@@ -1,0 +1,22 @@
+"""Persistent XLA compilation cache setup (single definition).
+
+This host has one slow CPU core; XLA backend compiles of the larger graphs
+take minutes, dominating cold test/benchmark runs.  Every entry point
+(tests/conftest.py, bench.py, scripts/*) enables the same repo-local cache
+through this helper so reruns skip compilation entirely.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compilation_cache(repo_root: str | None = None) -> None:
+    import jax
+
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(repo_root, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

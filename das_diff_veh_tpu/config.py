@@ -131,6 +131,9 @@ class GatherConfig:
     norm: bool = True                 # per-trace L2 norm of the gather
     norm_amp: bool = True             # normalize by pivot-trace max
     include_other_side: bool = True
+    far_offset: float = 75.0          # gather far end beyond the pivot [m]
+                                      # (reference end_x = x0 + 75, notebook
+                                      # save_disp_imgs / bootstrap geometry)
 
 
 @dataclass(frozen=True)
@@ -145,7 +148,14 @@ class DispersionConfig:
     vel_step: float = 1.0
     sg_window: int = 25               # savgol smoothing along frequency
     sg_order: int = 4
-    norm: bool = True                 # L1 trace norm before transform
+    # The reference's production imaging paths call map_fv with norm=False
+    # (apis/dispersion_classes.py:29-31, virtual_shot_gather.py:253-256 pass
+    # no norm argument; modules/utils.py:457 defaults norm=False).
+    norm: bool = False                # L1 trace norm before transform
+    # "fk": reference-parity map_fv (2-D FFT + bilinear k=f/v sampling);
+    # "phase_shift": frequency-domain slant stack (Park et al.), no padded
+    # 2-D FFT and no gather — the TPU-preferred path (see ops/dispersion.py).
+    method: str = "fk"
 
     @property
     def n_freqs(self) -> int:

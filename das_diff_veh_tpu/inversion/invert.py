@@ -75,15 +75,17 @@ class ModelSpec(NamedTuple):
         return jnp.asarray(lo), jnp.asarray(hi)
 
     def to_model(self, x01: jnp.ndarray) -> LayeredModel:
-        """Unit-cube parameter vector -> LayeredModel."""
+        """Unit-cube parameter vector -> LayeredModel (in x01's dtype)."""
+        x01 = jnp.asarray(x01)
         lo, hi = self.bounds_arrays()
+        lo, hi = lo.astype(x01.dtype), hi.astype(x01.dtype)
         x = lo + (hi - lo) * jnp.clip(x01, 0.0, 1.0)
         n = self.n_layers
         d, vs = x[:n], x[n:2 * n]
         if self.free_poisson:
             nu = x[2 * n:3 * n]
         else:
-            nu = jnp.asarray([b.poisson[0] for b in self.layers])
+            nu = jnp.asarray([b.poisson[0] for b in self.layers], x01.dtype)
         vp = vp_from_poisson(vs, nu)
         return LayeredModel(thickness=d, vp=vp, vs=vs, rho=self.density(vp))
 
@@ -115,34 +117,63 @@ def weight_model_spec() -> ModelSpec:
     ))
 
 
-def curve_misfit(model: LayeredModel, curve_period, curve_velocity,
-                 curve_unc, mode: int, n_grid: int):
-    """Uncertainty-normalised RMSE of one modal curve (evodcinv 'rmse')."""
-    pred = phase_velocity(curve_period, model, mode=mode, n_grid=n_grid)
-    r = (curve_velocity - pred) / curve_unc
-    r = jnp.where(jnp.isfinite(pred), r, INVALID_RESIDUAL)
-    return jnp.sqrt(jnp.mean(r * r))
-
-
 def make_misfit_fn(spec: ModelSpec, curves: Sequence[Curve],
-                   n_grid: int = 400):
+                   n_grid: int = 400, n_subdiv: int = 1, dtype=None,
+                   invalid: str = "penalty"):
     """misfit(x01) -> scalar, jit/vmap/grad-compatible.
 
-    Curves are baked in as static arrays (their lengths differ, so each
-    curve is its own closed-over computation; the small curve count makes
-    this cheap)."""
-    baked = [(jnp.asarray(c.period), jnp.asarray(c.velocity),
-              jnp.asarray(c.uncertainty if c.uncertainty is not None
-                          else np.ones_like(c.velocity)),
+    All curves' (period, mode) samples are concatenated so the forward
+    model runs as ONE batched root solve per misfit evaluation - modes 0,
+    3 and 4 share the same secular-function grid scan (one ``lax.scan``
+    over layers), which is what keeps both the XLA graph and the runtime
+    small.  Per-curve RMSE semantics (evodcinv 'rmse': per curve
+    ``sqrt(mean(((obs-pred)/unc)^2))``, weight-normalised sum) are then
+    recovered by static slicing of the concatenated prediction.
+
+    ``n_subdiv=1`` (default) keeps the root solve at ~0.1 m/s resolution —
+    two orders below the bootstrap-curve uncertainties — with a markedly
+    smaller XLA graph than the full-precision ``n_subdiv=3`` path.
+    ``dtype`` pins the working precision (e.g. float32 for a TPU search
+    under an x64-enabled process); None follows the default float type.
+    ``invalid`` selects below-cutoff overtone handling: ``"penalty"``
+    (ours: fixed INVALID_RESIDUAL per missing point — keeps the objective
+    sensitive to losing overtones) or ``"truncate"`` (evodcinv semantics:
+    missing points are dropped from the per-curve mean, reference
+    EarthModel misfit="rmse"; use this for apples-to-apples parity runs)."""
+    baked = [(np.asarray(c.period, dtype=np.float64),
+              np.asarray(c.velocity, dtype=np.float64),
+              np.asarray(c.uncertainty if c.uncertainty is not None
+                         else np.ones_like(c.velocity), dtype=np.float64),
               int(c.mode), float(c.weight)) for c in curves]
     wsum = sum(w for *_, w in baked)
+    period_all = jnp.asarray(np.concatenate([p for p, *_ in baked]), dtype)
+    mode_all = jnp.asarray(np.concatenate(
+        [np.full(len(p), m) for p, _, _, m, _ in baked]))
+    vel_all = jnp.asarray(np.concatenate([v for _, v, *_ in baked]), dtype)
+    unc_all = jnp.asarray(np.concatenate([u for _, _, u, *_ in baked]), dtype)
+    slices = np.cumsum([0] + [len(p) for p, *_ in baked])
+
+    assert invalid in ("penalty", "truncate")
 
     def misfit(x01):
         model = spec.to_model(x01)
+        pred = phase_velocity(period_all, model, mode=mode_all,
+                              n_grid=n_grid, n_subdiv=n_subdiv)
+        fin = jnp.isfinite(pred)
+        r = (vel_all - pred) / unc_all
+        r = jnp.where(fin, r, INVALID_RESIDUAL)
         total = 0.0
-        for period, vel, unc, mode, w in baked:
-            total = total + w * curve_misfit(model, period, vel, unc, mode,
-                                             n_grid)
+        for i, (*_, w) in enumerate(baked):
+            sl = slice(slices[i], slices[i + 1])
+            ri, fi = r[sl], fin[sl]
+            if invalid == "truncate":
+                n_fin = jnp.sum(fi)
+                rmse = jnp.sqrt(jnp.sum(jnp.where(fi, ri * ri, 0.0))
+                                / jnp.maximum(n_fin, 1))
+                rmse = jnp.where(n_fin > 0, rmse, INVALID_RESIDUAL)
+            else:
+                rmse = jnp.sqrt(jnp.mean(ri * ri))
+            total = total + w * rmse
         return total / wsum
 
     return misfit
@@ -162,23 +193,29 @@ class InversionResult(NamedTuple):
 
 
 @partial(jax.jit, static_argnames=("misfit_fn", "n_params", "popsize",
-                                   "maxiter"))
-def _pso(misfit_fn, key, n_params: int, popsize: int, maxiter: int):
-    """Inertial global-best PSO on the unit cube (w=0.73, c1=c2=1.496 -
-    the constriction coefficients the reference's stochopy CPSO also
-    defaults to), velocities clamped, positions clipped."""
-    w, c1, c2 = 0.7298, 1.49618, 1.49618
+                                   "dtype"))
+def _pso_init(misfit_fn, key, n_params: int, popsize: int, dtype=None):
+    dtype = dtype or jnp.zeros(()).dtype
     k1, k2 = jax.random.split(key)
-    x = jax.random.uniform(k1, (popsize, n_params))
-    v = 0.1 * (jax.random.uniform(k2, (popsize, n_params)) - 0.5)
+    x = jax.random.uniform(k1, (popsize, n_params), dtype=dtype)
+    v = 0.1 * (jax.random.uniform(k2, (popsize, n_params), dtype=dtype) - 0.5)
     f = jax.vmap(misfit_fn)(x)
-    pbest_x, pbest_f = x, f
     g = jnp.argmin(f)
-    gbest_x, gbest_f = x[g], f[g]
+    return (x, v, x, f, x[g], f[g])
+
+
+@partial(jax.jit, static_argnames=("misfit_fn", "n_iters"))
+def _pso_run(misfit_fn, state, key, n_iters: int):
+    """``n_iters`` inertial global-best PSO steps on the unit cube (w=0.73,
+    c1=c2=1.496 - the constriction coefficients the reference's stochopy
+    CPSO also defaults to), velocities clamped, positions clipped."""
+    w, c1, c2 = 0.7298, 1.49618, 1.49618
+    popsize, n_params = state[0].shape
+    dtype = state[0].dtype
 
     def step(state, key):
         x, v, pbest_x, pbest_f, gbest_x, gbest_f = state
-        r1 = jax.random.uniform(key, (2, popsize, n_params))
+        r1 = jax.random.uniform(key, (2, popsize, n_params), dtype=dtype)
         v = (w * v + c1 * r1[0] * (pbest_x - x)
              + c2 * r1[1] * (gbest_x[None] - x))
         v = jnp.clip(v, -0.25, 0.25)
@@ -193,23 +230,33 @@ def _pso(misfit_fn, key, n_params: int, popsize: int, maxiter: int):
         gbest_f = jnp.where(improved, pbest_f[g], gbest_f)
         return (x, v, pbest_x, pbest_f, gbest_x, gbest_f), gbest_f
 
-    keys = jax.random.split(jax.random.fold_in(key, 7), maxiter)
-    state, trace = jax.lax.scan(step, (x, v, pbest_x, pbest_f, gbest_x,
-                                       gbest_f), keys)
+    keys = jax.random.split(key, n_iters)
+    return jax.lax.scan(step, state, keys)
+
+
+def _pso(misfit_fn, key, n_params: int, popsize: int, maxiter: int,
+         dtype=None, chunk: int = 50):
+    """PSO driver: the iteration loop runs as host-chunked device calls of
+    ``chunk`` scan steps each — one compiled step body regardless of
+    maxiter, bounded single-call device time (long monolithic scans have
+    crashed the tunneled-TPU worker), and a natural progress boundary."""
+    state = _pso_init(misfit_fn, key, n_params, popsize, dtype)
+    traces = []
+    done = 0
+    while done < maxiter:
+        n = min(chunk, maxiter - done)
+        state, tr = _pso_run(misfit_fn, state, jax.random.fold_in(key, 7 + done), n)
+        traces.append(tr)
+        done += n
     x, v, pbest_x, pbest_f, gbest_x, gbest_f = state
-    return gbest_x, gbest_f, pbest_x, pbest_f, trace
+    return gbest_x, gbest_f, pbest_x, pbest_f, jnp.concatenate(traces)
 
 
-@partial(jax.jit, static_argnames=("misfit_fn", "n_steps"))
-def _refine(misfit_fn, x0_batch, n_steps: int, lr: float = 0.02):
-    """Vectorised multi-start Adam in logit space (keeps iterates strictly
-    inside the box while gradients stay unconstrained)."""
-    eps = 1e-4
-    z0 = jax.scipy.special.logit(jnp.clip(x0_batch, eps, 1.0 - eps))
+@partial(jax.jit, static_argnames=("misfit_fn", "n_steps", "lr"))
+def _refine_run(misfit_fn, z, opt_state, n_steps: int, lr: float):
     opt = optax.adam(lr)
 
-    def run_one(z):
-        state = opt.init(z)
+    def one(z, opt_state):
         def body(carry, _):
             z, state = carry
             loss, grad = jax.value_and_grad(
@@ -217,27 +264,54 @@ def _refine(misfit_fn, x0_batch, n_steps: int, lr: float = 0.02):
             grad = jnp.where(jnp.isfinite(grad), grad, 0.0)
             updates, state = opt.update(grad, state)
             return (optax.apply_updates(z, updates), state), loss
-        (z, _), losses = jax.lax.scan(body, (z, state), None, length=n_steps)
-        return jax.nn.sigmoid(z), misfit_fn(jax.nn.sigmoid(z))
+        (z, state), _ = jax.lax.scan(body, (z, opt_state), None,
+                                     length=n_steps)
+        return z, state
 
-    return jax.vmap(run_one)(z0)
+    return jax.vmap(one)(z, opt_state)
+
+
+def _refine(misfit_fn, x0_batch, n_steps: int, lr: float = 0.02,
+            chunk: int = 50):
+    """Vectorised multi-start Adam in logit space (keeps iterates strictly
+    inside the box while gradients stay unconstrained).  Host-chunked like
+    :func:`_pso` to bound single device-call time."""
+    eps = 1e-4
+    z = jax.scipy.special.logit(jnp.clip(x0_batch, eps, 1.0 - eps))
+    opt_state = jax.vmap(optax.adam(lr).init)(z)
+    done = 0
+    while done < n_steps:
+        n = min(chunk, n_steps - done)
+        z, opt_state = _refine_run(misfit_fn, z, opt_state, n, lr)
+        done += n
+    x = jax.nn.sigmoid(z)
+    return x, _misfit_batch(misfit_fn, x)
+
+
+@partial(jax.jit, static_argnames=("misfit_fn",))
+def _misfit_batch(misfit_fn, x):
+    return jax.vmap(misfit_fn)(x)
 
 
 def invert(spec: ModelSpec, curves: Sequence[Curve], *, popsize: int = 50,
            maxiter: int = 200, n_refine_starts: int = 8,
            n_refine_steps: int = 80, n_grid: int = 400,
+           n_subdiv: int = 1, dtype=None, invalid: str = "penalty",
            seed: int = 0) -> InversionResult:
     """Swarm search + gradient refinement for a 1-D Vs profile.
 
     Matches the role of ``EarthModel.invert(curves, maxrun=5)`` with CPSO
     popsize 50 x maxiter 1000 (inversion_diff_speed.ipynb cell 9); the
-    gradient stage makes far fewer forward evaluations necessary for the
-    same (or better) final misfit.
+    whole population evaluates as one batched forward solve per iteration
+    and a gradient stage polishes the best basins (far fewer forward
+    evaluations for the same or better final misfit).
     """
-    misfit_fn = make_misfit_fn(spec, curves, n_grid=n_grid)
+    misfit_fn = make_misfit_fn(spec, curves, n_grid=n_grid,
+                               n_subdiv=n_subdiv, dtype=dtype,
+                               invalid=invalid)
     key = jax.random.PRNGKey(seed)
     gbest_x, gbest_f, pop_x, pop_f, trace = _pso(
-        misfit_fn, key, spec.n_params, popsize, maxiter)
+        misfit_fn, key, spec.n_params, popsize, maxiter, dtype=dtype)
 
     k = min(n_refine_starts, popsize)
     top = jnp.argsort(pop_f)[:k]

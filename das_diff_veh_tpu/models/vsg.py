@@ -31,7 +31,7 @@ from das_diff_veh_tpu.config import DispersionConfig, GatherConfig
 from das_diff_veh_tpu.core.section import WindowBatch
 from das_diff_veh_tpu.ops.interp import masked_interp
 from das_diff_veh_tpu.ops import xcorr as xc
-from das_diff_veh_tpu.ops.dispersion import fv_map_fk
+from das_diff_veh_tpu.ops.dispersion import fv_map_fk, fv_map_phase_shift
 
 
 @dataclass(frozen=True)
@@ -166,15 +166,32 @@ def stack_gathers(gathers: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
 def gather_disp_image(xcf: jnp.ndarray, offsets: np.ndarray, dt: float,
                       dx: float, cfg: DispersionConfig = DispersionConfig(),
                       start_x: float | None = None,
-                      end_x: float | None = None) -> jnp.ndarray:
+                      end_x: float | None = None,
+                      enhance: bool = False) -> jnp.ndarray:
     """Dispersion image of (a stack of) gathers over an offset sub-range
     (reference VirtualShotGather.compute_disp_image,
     apis/virtual_shot_gather.py:247-258 — which hardcodes dx=8.16; here the
-    interrogator's dx is a parameter).  Returns (nvel, nfreq)."""
+    interrogator's dx is a parameter).  Returns (nvel, nfreq).
+
+    ``cfg.method`` selects the transform: ``"fk"`` is the reference-parity
+    2-D-FFT path; ``"phase_shift"`` is the frequency-domain slant stack
+    (direction -1: the gather's offsets ascend toward the virtual source at
+    0, so lag grows with decreasing x — see ops/dispersion.py).
+    ``enhance=True`` applies the reference's CLAHE + blur post-processing
+    (fv_map_enhance, modules/utils.py:613-619) and returns int32 0..255."""
     offsets = np.asarray(offsets)
     sxi = int(np.abs(offsets - (start_x if start_x is not None else offsets[0])).argmin())
     exi = int(np.abs(offsets - (end_x if end_x is not None else offsets[-1])).argmin())
     freqs = jnp.arange(cfg.freq_min, cfg.freq_max, cfg.freq_step)
     vels = jnp.arange(cfg.vel_min, cfg.vel_max, cfg.vel_step)
-    return fv_map_fk(xcf[..., sxi:exi + 1, :], dx, dt, freqs, vels,
-                     norm=cfg.norm, sg_window=cfg.sg_window, sg_order=cfg.sg_order)
+    sliced = xcf[..., sxi:exi + 1, :]
+    if cfg.method == "phase_shift":
+        img = fv_map_phase_shift(sliced, dx, dt, freqs, vels,
+                                 direction=-1.0, whiten=False)
+    else:
+        img = fv_map_fk(sliced, dx, dt, freqs, vels, norm=cfg.norm,
+                        sg_window=cfg.sg_window, sg_order=cfg.sg_order)
+    if enhance:
+        from das_diff_veh_tpu.ops.enhance import fv_map_enhance
+        img = fv_map_enhance(img)
+    return img

@@ -1,0 +1,139 @@
+"""f-v map enhancement: CLAHE + box blur (reference ``fv_map_enhance``,
+modules/utils.py:613-619: normalize -> uint8 -> cv2 CLAHE(clipLimit=100,
+tileGridSize=(100, 10)) -> 10x10 blur).
+
+Re-implemented as pure jnp following OpenCV's CLAHE algorithm
+(modules/imgproc clahe.cpp semantics, written from the published algorithm,
+parity-tested against cv2 in tests/test_enhance.py):
+
+1. pad right/bottom with BORDER_REFLECT_101 so tiles divide evenly;
+2. per-tile 256-bin histogram (one scatter-add over the flattened image);
+3. clip at ``max(clipLimit * tileArea / 256, 1)`` and redistribute the
+   clipped excess (uniform part + OpenCV's stride-pattern residual);
+4. per-tile LUT = round(cdf * 255 / tileArea);
+5. per-pixel bilinear interpolation between the four neighboring tile LUTs.
+
+The histograms/LUTs are one batched scatter + cumsum, the interpolation is
+four gathers — no Python loops, jit/vmap-friendly, TPU-compatible.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _pad_reflect101(img: jnp.ndarray, pad_h: int, pad_w: int) -> jnp.ndarray:
+    """cv2 BORDER_REFLECT_101 padding on the bottom/right edges.
+
+    numpy's "reflect" mode is exactly REFLECT_101 (no edge repeat) and also
+    handles pads wider than the image (repeated reflection) — reached when
+    the tile grid exceeds the image, e.g. 100 frequency tiles on a coarse
+    test map."""
+    if pad_h or pad_w:
+        img = jnp.pad(img, ((0, pad_h), (0, pad_w)), mode="reflect")
+    return img
+
+
+@partial(jax.jit, static_argnames=("clip_limit", "tiles"))
+def clahe_u8(img: jnp.ndarray, clip_limit: float = 100.0,
+             tiles: tuple[int, int] = (100, 10)) -> jnp.ndarray:
+    """Contrast-limited adaptive histogram equalization of a uint8-valued
+    image (values 0..255, any integer/float dtype accepted).
+
+    ``tiles`` follows cv2's tileGridSize convention ``(tilesX, tilesY)`` =
+    (columns of tiles, rows of tiles).  Returns int32 values 0..255.
+    """
+    tx, ty = tiles
+    img = jnp.asarray(img).astype(jnp.int32)
+    H, W = img.shape
+    th = -(-H // ty)          # tile height (ceil)
+    tw = -(-W // tx)
+    imgp = _pad_reflect101(img, ty * th - H, tx * tw - W)
+
+    # --- per-tile histograms: one scatter-add ------------------------------
+    Hp, Wp = ty * th, tx * tw
+    row_tile = jnp.arange(Hp) // th                      # (Hp,)
+    col_tile = jnp.arange(Wp) // tw                      # (Wp,)
+    tile_id = row_tile[:, None] * tx + col_tile[None, :]  # (Hp, Wp)
+    flat_id = tile_id.reshape(-1) * 256 + imgp.reshape(-1)
+    hist = jnp.zeros((ty * tx * 256,), jnp.int32).at[flat_id].add(1)
+    hist = hist.reshape(ty * tx, 256)
+
+    # --- clip + redistribute (OpenCV semantics) ----------------------------
+    area = th * tw
+    clip = max(int(clip_limit * area / 256.0), 1)
+    clipped = jnp.minimum(hist, clip)
+    excess = jnp.sum(hist - clipped, axis=1, keepdims=True)   # (ntiles, 1)
+    bin_incr = excess // 256
+    residual = excess - bin_incr * 256                        # (ntiles, 1)
+    hist2 = clipped + bin_incr
+    # OpenCV walks i = 0, step, 2*step, ... adding 1 while residual lasts,
+    # with step = max(256 // residual, 1)
+    step = jnp.maximum(256 // jnp.maximum(residual, 1), 1)
+    i = jnp.arange(256)[None, :]
+    gets_one = (i % step == 0) & (i // step < residual)
+    hist2 = hist2 + gets_one.astype(jnp.int32)
+
+    # --- LUTs --------------------------------------------------------------
+    scale = 255.0 / area
+    luts = jnp.clip(jnp.round(jnp.cumsum(hist2, axis=1) * scale),
+                    0, 255).astype(jnp.int32)                 # (ntiles, 256)
+
+    # --- bilinear interpolation between tile LUTs --------------------------
+    yf = (jnp.arange(H) + 0.5) / th - 0.5
+    xf = (jnp.arange(W) + 0.5) / tw - 0.5
+    y1 = jnp.floor(yf).astype(jnp.int32)
+    x1 = jnp.floor(xf).astype(jnp.int32)
+    wy = (yf - y1)[:, None]
+    wx = (xf - x1)[None, :]
+    y1c = jnp.clip(y1, 0, ty - 1)[:, None]
+    y2c = jnp.clip(y1 + 1, 0, ty - 1)[:, None]
+    x1c = jnp.clip(x1, 0, tx - 1)[None, :]
+    x2c = jnp.clip(x1 + 1, 0, tx - 1)[None, :]
+
+    v = img
+    lut_at = lambda tyi, txi: luts[tyi * tx + txi, v]
+    top = lut_at(y1c, x1c) * (1 - wx) + lut_at(y1c, x2c) * wx
+    bot = lut_at(y2c, x1c) * (1 - wx) + lut_at(y2c, x2c) * wx
+    out = top * (1 - wy) + bot * wy
+    return jnp.clip(jnp.round(out), 0, 255).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("ksize",))
+def box_blur_u8(img: jnp.ndarray, ksize: int = 10) -> jnp.ndarray:
+    """cv2.blur semantics: normalized ``ksize x ksize`` box filter with
+    BORDER_REFLECT_101 edges and the anchor at ``ksize // 2`` (so an even
+    kernel reaches ``ksize//2`` up/left and ``ksize//2 - 1`` down/right)."""
+    img = jnp.asarray(img).astype(jnp.float32)
+    a = ksize // 2
+    b = ksize - 1 - a
+    # reflect-101 pad: top/left a, bottom/right b
+    top = img[1:1 + a][::-1]
+    botr = img[-1 - b:-1][::-1]
+    img = jnp.concatenate([top, img, botr], axis=0)
+    left = img[:, 1:1 + a][:, ::-1]
+    right = img[:, -1 - b:-1][:, ::-1]
+    img = jnp.concatenate([left, img, right], axis=1)
+    k = jnp.full((ksize, ksize), 1.0 / (ksize * ksize), jnp.float32)
+    blurred = jax.lax.conv_general_dilated(
+        img[None, None], k[None, None],
+        window_strides=(1, 1), padding="VALID")[0, 0]
+    return jnp.clip(jnp.round(blurred), 0, 255).astype(jnp.int32)
+
+
+def fv_map_enhance(fv_map: jnp.ndarray, clip_limit: float = 100.0,
+                   tiles: tuple[int, int] = (100, 10),
+                   blur_ksize: int = 10) -> jnp.ndarray:
+    """Reference fv_map_enhance (modules/utils.py:613-619): normalize by
+    ``(fv - min) / max`` (the reference divides by the raw max, not the
+    range), quantize to uint8 by truncation, CLAHE, 10x10 blur.  Returns
+    int32 values 0..255."""
+    fv = jnp.asarray(fv_map)
+    mx = jnp.max(fv)
+    fv = (fv - jnp.min(fv)) / jnp.where(mx != 0, mx, 1.0)  # all-constant map -> 0
+    u8 = jnp.clip((fv * 255.0), 0, 255).astype(jnp.int32)  # C-cast truncation
+    eq = clahe_u8(u8, clip_limit=clip_limit, tiles=tiles)
+    return box_blur_u8(eq, ksize=blur_ksize)

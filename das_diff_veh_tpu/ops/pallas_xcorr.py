@@ -1,0 +1,203 @@
+"""Pallas-tiled all-pairs windowed cross-correlation (BASELINE config 4).
+
+The all-pairs generalization of the reference's XCORR_vshot loop
+(modules/utils.py:289-314) is, in the frequency domain,
+
+    C[s, r, f] = (1/nwin) * sum_w  S[s, w, f] * conj(S[r, w, f])
+
+followed by an irfft over f.  ``ops.xcorr.xcorr_vshot_batch`` evaluates this
+with one einsum that materializes the (nsrc, nrcv, nwin, nf) product — fine
+for the ~40-channel imaging gathers, hopeless for the synthetic 10k-channel
+ambient-noise config (that intermediate would be ~10 TB, and even the full
+(nch, nch, nf) spectra cube is ~800 GB).
+
+This module therefore streams at two levels:
+
+1. *Source-chunk loop* (``lax.map``): only ``src_chunk`` source rows'
+   spectra/lag products exist at a time.
+2. *Pallas kernel* inside each chunk: the (src-tile x rcv-tile x f-block)
+   grid loads two (tile, nwin, fblock) spectra tiles into VMEM, forms the
+   complex product and accumulates the window mean in one pass — HBM
+   traffic is one read of each spectra tile per (s, r) tile pair plus one
+   output-tile write; no (s, r, w, f) intermediate ever exists.
+
+Each chunk is finished in the lag domain (irfft + zero-lag roll + lag trim,
+or a per-pair peak reduction) before the next chunk starts, so arbitrarily
+large channel counts run in bounded memory.
+
+Below ``PALLAS_MIN_CH`` channels (or on non-TPU backends) an XLA batched
+contraction ``einsum("swf,rwf->srf")`` replaces the kernel — same math,
+also 4-D-free, without explicit tiling control.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from das_diff_veh_tpu.ops.xcorr import sliding_windows
+
+PALLAS_MIN_CH = 512     # below this the XLA einsum path wins (compile + pad overhead)
+# Mosaic requires the last block dim divisible by 128 (lanes); VMEM is kept
+# under the 16 MB limit by shrinking the channel tiles instead: out tiles are
+# (32, 32, 128) f32 x2 outputs x2 pipeline buffers ~= 2 MB.
+_TILE_CH = 32           # (src, rcv) tile edge
+_TILE_F = 128           # frequency block (lane-aligned)
+
+
+def _spectra_tile_kernel(nwin: int, sr, si, rr, ri, cr, ci):
+    """One (src-tile, rcv-tile, f-block) step: window-mean complex product.
+
+    Block shapes: sr/si (Ts, nwin, fb), rr/ri (Tr, nwin, fb),
+    cr/ci (Ts, Tr, fb).  The w loop is static (nwin is small — ~7 for the
+    reference's 50%-overlap 2 s windows in 8 s records); each term is a VPU
+    broadcast multiply-accumulate, all operands resident in VMEM.
+    """
+    acc_r = jnp.zeros(cr.shape, jnp.float32)
+    acc_i = jnp.zeros(ci.shape, jnp.float32)
+    for w in range(nwin):
+        a, b = sr[:, w, :], si[:, w, :]          # (Ts, fb)
+        c, d = rr[:, w, :], ri[:, w, :]          # (Tr, fb)
+        # (a + ib)(c - id) = (ac + bd) + i(bc - ad), outer over (Ts, Tr)
+        acc_r += a[:, None, :] * c[None, :, :] + b[:, None, :] * d[None, :, :]
+        acc_i += b[:, None, :] * c[None, :, :] - a[:, None, :] * d[None, :, :]
+    inv = jnp.float32(1.0 / nwin)
+    cr[:] = acc_r * inv
+    ci[:] = acc_i * inv
+
+
+def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
+    n = x.shape[axis]
+    pad = (-n) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def _pallas_cross_spectra(src_r, src_i, all_r, all_i,
+                          interpret: bool = False) -> jnp.ndarray:
+    """(m, nwin, nf) source-row spectra x (nch, nwin, nf) full spectra ->
+    (m, nch, nf) complex window-mean cross-spectra via the tiled kernel.
+    Pads m/nch to _TILE_CH and nf to _TILE_F; slices the padding back off."""
+    m, nwin, nf = src_r.shape
+    nch = all_r.shape[0]
+    src_r = _pad_to(_pad_to(src_r, 0, _TILE_CH), 2, _TILE_F)
+    src_i = _pad_to(_pad_to(src_i, 0, _TILE_CH), 2, _TILE_F)
+    all_r = _pad_to(_pad_to(all_r, 0, _TILE_CH), 2, _TILE_F)
+    all_i = _pad_to(_pad_to(all_i, 0, _TILE_CH), 2, _TILE_F)
+    mp, ncp, nfp = src_r.shape[0], all_r.shape[0], src_r.shape[2]
+    grid = (mp // _TILE_CH, ncp // _TILE_CH, nfp // _TILE_F)
+    src_spec = pl.BlockSpec((_TILE_CH, nwin, _TILE_F),
+                            lambda i, j, k: (i, 0, k),
+                            memory_space=pltpu.VMEM)
+    rcv_spec = pl.BlockSpec((_TILE_CH, nwin, _TILE_F),
+                            lambda i, j, k: (j, 0, k),
+                            memory_space=pltpu.VMEM)
+    out_spec = pl.BlockSpec((_TILE_CH, _TILE_CH, _TILE_F),
+                            lambda i, j, k: (i, j, k),
+                            memory_space=pltpu.VMEM)
+    out_shape = [jax.ShapeDtypeStruct((mp, ncp, nfp), jnp.float32)] * 2
+    cr, ci = pl.pallas_call(
+        partial(_spectra_tile_kernel, nwin),
+        grid=grid,
+        in_specs=[src_spec, src_spec, rcv_spec, rcv_spec],
+        out_specs=[out_spec, out_spec],
+        out_shape=out_shape,
+        interpret=interpret,
+    )(src_r, src_i, all_r, all_i)
+    return (cr + 1j * ci)[:m, :nch, :nf]
+
+
+def _window_spectra(data: jnp.ndarray, wlen: int,
+                    overlap_ratio: float) -> jnp.ndarray:
+    offset = int(wlen * (1.0 - overlap_ratio))
+    wins = sliding_windows(data, wlen, offset)           # (nch, nwin, wlen)
+    return jnp.fft.rfft(wins.astype(jnp.float32), axis=-1)
+
+
+def _decide_pallas(nch: int, use_pallas: bool | None) -> bool:
+    if use_pallas is None:
+        return (nch >= PALLAS_MIN_CH
+                and jax.default_backend() not in ("cpu",))
+    return use_pallas
+
+
+def _cross_spectra(src_wf, all_wf, use_pallas: bool, interpret: bool):
+    """(m, nwin, nf) x (nch, nwin, nf) -> (m, nch, nf) window-mean products."""
+    if use_pallas:
+        return _pallas_cross_spectra(
+            src_wf.real.astype(jnp.float32), src_wf.imag.astype(jnp.float32),
+            all_wf.real.astype(jnp.float32), all_wf.imag.astype(jnp.float32),
+            interpret=interpret)
+    # HIGHEST: TPUs otherwise contract this complex matmul on the MXU in
+    # bfloat16, which visibly degrades the spectra (the Pallas kernel is
+    # exact f32 VPU arithmetic; keep the fallback numerically equivalent)
+    return jnp.einsum("swf,rwf->srf", src_wf, jnp.conj(all_wf),
+                      precision=jax.lax.Precision.HIGHEST) / src_wf.shape[1]
+
+
+def _chunked(wf: jnp.ndarray, src_chunk: int, finish):
+    """Map ``finish(cross-spectra of chunk rows)`` over source-row chunks."""
+    nch = wf.shape[0]
+    if nch <= src_chunk:
+        return finish(wf)[0:nch]
+    pad = (-nch) % src_chunk
+    wfp = jnp.pad(wf, ((0, pad), (0, 0), (0, 0)))
+    out = jax.lax.map(finish, wfp.reshape(-1, src_chunk, *wf.shape[1:]))
+    return out.reshape(-1, *out.shape[2:])[:nch]
+
+
+def xcorr_all_pairs(data: jnp.ndarray, wlen: int, overlap_ratio: float = 0.5,
+                    lag_keep: int | None = None, src_chunk: int = 128,
+                    use_pallas: bool | None = None,
+                    interpret: bool = False) -> jnp.ndarray:
+    """All-pairs lag-domain xcorr, zero lag centered — the (nch, nch, ...)
+    generalization of ``xcorr_vshot_batch`` (parity-tested against it in
+    tests/test_pallas_xcorr.py).
+
+    ``lag_keep`` trims to the +-lag_keep samples around zero lag (standard
+    ambient-noise practice; the full 10k x 10k x wlen cube would be ~800 GB).
+    Source rows are processed ``src_chunk`` at a time; each chunk's spectra
+    are finished (irfft, roll, trim) before the next chunk starts.
+    """
+    wf = _window_spectra(data, wlen, overlap_ratio)
+    use_p = _decide_pallas(wf.shape[0], use_pallas)
+    mid = wlen // 2
+    sl = slice(0, wlen) if lag_keep is None else slice(mid - lag_keep,
+                                                       mid + lag_keep + 1)
+
+    def finish(src_rows):
+        spec = _cross_spectra(src_rows, wf, use_p, interpret)
+        c = jnp.fft.irfft(spec, n=wlen, axis=-1)
+        return jnp.roll(c, mid, axis=-1)[..., sl]
+
+    return _chunked(wf, src_chunk, finish)
+
+
+def xcorr_all_pairs_peak(data: jnp.ndarray, wlen: int,
+                         overlap_ratio: float = 0.5, src_chunk: int = 64,
+                         use_pallas: bool | None = None,
+                         interpret: bool = False) -> jnp.ndarray:
+    """Per-pair peak |xcorr| over all lags: (nch, nch) float32.
+
+    The fully streamed form for channel counts where even a trimmed lag
+    cube exceeds HBM (the 10k-channel config): per chunk, spectra tiles ->
+    irfft -> lag-axis max reduction; nothing larger than
+    (src_chunk, nch, wlen) ever materializes.
+    """
+    wf = _window_spectra(data, wlen, overlap_ratio)
+    use_p = _decide_pallas(wf.shape[0], use_pallas)
+
+    def finish(src_rows):
+        spec = _cross_spectra(src_rows, wf, use_p, interpret)
+        c = jnp.fft.irfft(spec, n=wlen, axis=-1)
+        return jnp.max(jnp.abs(c), axis=-1)
+
+    return _chunked(wf, src_chunk, finish)

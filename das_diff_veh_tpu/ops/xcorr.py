@@ -77,10 +77,13 @@ def xcorr_vshot_batch(data: jnp.ndarray, wlen: int, overlap_ratio: float = 0.5,
                       reverse: bool = False) -> jnp.ndarray:
     """All-pairs generalization: every channel as virtual source.
 
-    Returns (nch_src, nch_rcv, wlen).  One einsum in the frequency domain —
-    the building block of the 10k-channel ambient-noise config
-    (BASELINE.json config 4); for channel counts that exceed HBM the Pallas
-    tiled variant in ops/pallas_xcorr.py streams the (src, rcv) tile space.
+    Returns (nch_src, nch_rcv, wlen).  One einsum in the frequency domain;
+    note it materializes the (nsrc, nrcv, nwin, nf) product, so it is for
+    imaging-sized gathers (~40 channels).  For the 10k-channel ambient-noise
+    config (BASELINE.json config 4) use ``ops.pallas_xcorr.xcorr_all_pairs``
+    / ``xcorr_all_pairs_peak`` — a source-chunked Pallas tiled kernel that
+    never materializes the pair-window product (parity-tested against this
+    function in tests/test_pallas_xcorr.py).
     """
     offset = int(wlen * (1.0 - overlap_ratio))
     wins = sliding_windows(data, wlen, offset)          # (nch, nwin, wlen)

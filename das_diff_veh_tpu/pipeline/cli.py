@@ -15,15 +15,18 @@ from das_diff_veh_tpu.pipeline.workflow import run_date_range
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="Vehicle-DAS time-lapse imaging")
-    p.add_argument("--data_root", required=True, help="root with per-date npz folders")
-    p.add_argument("--start_date", required=True, help="YYYYMMDD")
-    p.add_argument("--end_date", required=True, help="YYYYMMDD")
+    p.add_argument("--data_root", help="root with per-date npz folders")
+    p.add_argument("--start_date", help="YYYYMMDD")
+    p.add_argument("--end_date", help="YYYYMMDD")
     p.add_argument("--out_dir", default="results")
     p.add_argument("--method", default="xcorr", choices=["xcorr", "surface_wave"])
     p.add_argument("--x0", type=float, default=700.0, help="pivot along fiber [m]")
     p.add_argument("--n_min_save", type=float, default=60.0,
                    help="checkpoint the running average every N data-minutes")
     p.add_argument("--verbal", action="store_true", help="per-chunk progress logs")
+    p.add_argument("--figures", action="store_true",
+                   help="write the reference QC figure set from a synthetic "
+                        "run into out_dir and exit (no data_root needed)")
     return p
 
 
@@ -31,6 +34,14 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     logging.basicConfig(level=logging.INFO if args.verbal else logging.WARNING,
                         format="%(asctime)s %(name)s %(message)s")
+    if args.figures:
+        from das_diff_veh_tpu.viz import figure_set_from_synthetic
+        for f in figure_set_from_synthetic(args.out_dir):
+            print(f)
+        return 0
+    if not (args.data_root and args.start_date and args.end_date):
+        build_parser().error("--data_root/--start_date/--end_date are "
+                             "required unless --figures is given")
     cfg = PipelineConfig().replace(imaging=ImagingConfig(x0=args.x0))
     summary = run_date_range(args.data_root, args.start_date, args.end_date,
                              cfg=cfg, method=args.method, out_dir=args.out_dir,

@@ -35,7 +35,7 @@ class ChunkResult:
     n_windows: int                   # accepted (isolated) vehicle windows
     tracks: VehicleTracks
     batch: WindowBatch               # surface-wave-band windows
-    qs_batch: WindowBatch            # raw-band windows (quasi-static weights)
+    qs_batch: Optional[WindowBatch]  # raw-band windows (with_qs=True only)
 
 
 def disp_image_batch(batch: WindowBatch, cfg: PipelineConfig) -> jnp.ndarray:
@@ -55,7 +55,7 @@ def disp_image_batch(batch: WindowBatch, cfg: PipelineConfig) -> jnp.ndarray:
     vels = jnp.arange(dcfg.vel_min, dcfg.vel_max, dcfg.vel_step)
     dt = float(batch.t[0, 1] - batch.t[0, 0])
 
-    from das_diff_veh_tpu.ops.dispersion import fv_map_fk
+    from das_diff_veh_tpu.ops.dispersion import fv_map_fk, fv_map_phase_shift
 
     def one(args):
         data, t, tx, tt = args
@@ -63,7 +63,11 @@ def disp_image_batch(batch: WindowBatch, cfg: PipelineConfig) -> jnp.ndarray:
                               offset=cfg.mute.offset, alpha=cfg.mute.alpha,
                               delta_x=cfg.mute.delta_x)
         muted = data * mask
-        return fv_map_fk(muted[sxi:sxi + nx], dx, dt, freqs, vels,
+        sliced = muted[sxi:sxi + nx]
+        if dcfg.method == "phase_shift":
+            return fv_map_phase_shift(sliced, dx, dt, freqs, vels,
+                                      direction=-1.0, whiten=False)
+        return fv_map_fk(sliced, dx, dt, freqs, vels,
                          norm=dcfg.norm, sg_window=dcfg.sg_window,
                          sg_order=dcfg.sg_order)
 
@@ -74,13 +78,17 @@ def disp_image_batch(batch: WindowBatch, cfg: PipelineConfig) -> jnp.ndarray:
 
 
 def process_chunk(section: DasSection, cfg: PipelineConfig = PipelineConfig(),
-                  method: str = "xcorr", x_is_channels: bool = False) -> ChunkResult:
+                  method: str = "xcorr", x_is_channels: bool = False,
+                  with_qs: bool = False) -> ChunkResult:
     """Full per-chunk pipeline (reference TimeLapseImaging usage in
     apis/imaging_workflow.py:50-67): preprocess both bands, track, select
     windows around cfg.imaging.x0, and build the method's stacked image.
 
     ``method``: 'xcorr' (virtual shot gathers -> dispersion of the stack) or
     'surface_wave' (muted direct dispersion per window, averaged).
+    ``with_qs``: also cut raw-band windows for quasi-static weight analysis
+    (reference qs_selector, apis/timeLapseImaging.py:183-191); off by default
+    because the imaging workflow never consumes them.
     """
     assert method in {"xcorr", "surface_wave"}
     x_dist = (channels_to_distance(section.x, cfg.interrogator)
@@ -105,13 +113,15 @@ def process_chunk(section: DasSection, cfg: PipelineConfig = PipelineConfig(),
     # --- select windows: filtered band + raw band (quasi-static weights),
     #     reference select_surface_wave_windows (:166-192) ---------------------
     batch = select_windows(d_sw, x_dist, t, tracks, cfg.imaging.x0, cfg.window)
-    qs_batch = select_windows(data, x_dist, t, tracks, cfg.imaging.x0, cfg.window)
+    qs_batch = (select_windows(data, x_dist, t, tracks, cfg.imaging.x0,
+                               cfg.window) if with_qs else None)
 
     n_windows = int(jnp.sum(batch.valid))
     if method == "xcorr":
         g = V.VsgGeometry.build(np.asarray(batch.x), dt, cfg.imaging.x0,
                                 cfg.imaging.x0 + cfg.imaging.disp_start_x,
-                                cfg.imaging.x0 + 75.0, cfg.gather)
+                                cfg.imaging.x0 + cfg.gather.far_offset,
+                                cfg.gather)
         gathers = V.build_gather_batch(batch, g, cfg.gather)
         stack = V.stack_gathers(gathers, batch.valid)
         img = V.gather_disp_image(stack, g.offsets(np.asarray(batch.x)), dt,

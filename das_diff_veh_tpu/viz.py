@@ -1,0 +1,361 @@
+"""Visualization — the reference's QC-by-plotting capability as a library.
+
+Mirrors (semantics, not code) the reference's figure set:
+
+- waterfall ``plot_waterfall``        <- plot_data, modules/utils.py:198-217
+  (minus its NameError bug at :210 — the colorbar referenced undefined
+  ``cax``/``fig``)
+- track overlay ``plot_tracks``       <- tracking_visulization_one_section,
+  apis/tracking.py:170-191
+- window rectangles ``plot_windows``  <- SurfaceWaveWindow.plot_on_data /
+  overlay_windows_on_data, apis/data_classes.py:41-47,238-244
+- gather ``plot_gather``              <- plot_xcorr, modules/utils.py:331-377
+  (pivot-trace amplitude norm, seismic colormap, offset x lag extent)
+- f-v map ``plot_fv_map``             <- plot_fv_map incl. the norm_part
+  high-frequency/high-velocity re-normalization block,
+  modules/utils.py:522-581
+- dispersion curves ``plot_disp_curves`` <- modules/utils.py:680-713
+  (bootstrap spaghetti + every-5th-point std error bars; returns
+  means/ranges/stds like the reference)
+- per-class figures ``save_class_figures`` <- save_disp_imgs,
+  apis/imaging_classes.py:50-85 (gather + norm/no-norm f-v figures per
+  vehicle class)
+- inversion ensemble ``plot_model_ensemble`` <- inversion_diff_speed.ipynb
+  cell 12 role (profiles colored by misfit, best model highlighted)
+
+All functions draw on a supplied/created matplotlib Axes and return it;
+``fig_path=`` saves to disk.  Arrays may be jax or numpy.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+import matplotlib
+
+if not os.environ.get("DISPLAY") and not os.environ.get("MPLBACKEND"):
+    # headless fallback only — never clobber an interactive session's backend
+    matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+
+def _np(a):
+    return np.asarray(a)
+
+
+def _save(fig, fig_path: Optional[str]):
+    if fig_path:
+        os.makedirs(os.path.dirname(fig_path) or ".", exist_ok=True)
+        fig.savefig(fig_path, bbox_inches="tight")
+        plt.close(fig)
+
+
+def plot_waterfall(data, x, t, pclip: float = 98, ax=None, cmap="seismic",
+                   fig_path: Optional[str] = None):
+    """DAS waterfall, time down, amplitude clipped at the ``pclip``-th
+    percentile (reference plot_data semantics, modules/utils.py:198-217)."""
+    data, x, t = _np(data), _np(x), _np(t)
+    if ax is None:
+        fig, ax = plt.subplots(figsize=(8, 8))
+    else:
+        fig = ax.figure
+    vmax = np.percentile(np.abs(data), pclip)
+    im = ax.imshow(data.T, aspect="auto",
+                   extent=[x[0], x[-1], t[-1], t[0]],
+                   cmap=cmap, vmax=vmax, vmin=-vmax)
+    fig.colorbar(im, ax=ax, label="DAS response")
+    ax.set_xlabel("Distance (m)")
+    ax.set_ylabel("Time (s)")
+    _save(fig, fig_path)
+    return ax
+
+
+def plot_tracks(tracks, ax=None, color="red", markersize: float = 1.0,
+                fig_path: Optional[str] = None):
+    """Overlay tracked vehicle arrival times (red dots per channel) on an
+    existing waterfall axes (reference apis/tracking.py:177-181)."""
+    if ax is None:
+        _, ax = plt.subplots(figsize=(8, 8))
+    t_idx = _np(tracks.t_idx)
+    x = _np(tracks.x)
+    t = _np(tracks.t)
+    valid = _np(tracks.valid)
+    for v in range(t_idx.shape[0]):
+        if not valid[v]:
+            continue
+        ok = np.isfinite(t_idx[v])
+        idx = np.clip(t_idx[v][ok].astype(int), 0, len(t) - 1)
+        ax.plot(x[ok], t[idx], ".", color=color, markersize=markersize)
+    _save(ax.figure, fig_path)
+    return ax
+
+
+def plot_windows(batch, ax=None, color="y", fig_path: Optional[str] = None):
+    """Draw each valid window's space-time rectangle on a waterfall axes
+    (reference SurfaceWaveWindow.plot_on_data, apis/data_classes.py:41-47)."""
+    if ax is None:
+        _, ax = plt.subplots(figsize=(8, 8))
+    x = _np(batch.x)
+    t = _np(batch.t)
+    valid = _np(batch.valid)
+    for w in range(t.shape[0]):
+        if not valid[w]:
+            continue
+        t0, t1 = t[w, 0], t[w, -1]
+        ax.plot([x[0], x[-1], x[-1], x[0], x[0]],
+                [t0, t0, t1, t1, t0], "-", color=color, linewidth=1)
+    _save(ax.figure, fig_path)
+    return ax
+
+
+def plot_gather(xcf, lags, offsets, ax=None, cmap="seismic",
+                x_lim=(-120.0, 120.0), fig_path: Optional[str] = None):
+    """Virtual-shot-gather image: offset x lag time, amplitudes normalized by
+    the zero-offset (pivot) trace's max (reference plot_xcorr,
+    modules/utils.py:331-377)."""
+    xcf, lags, offsets = _np(xcf), _np(lags), _np(offsets)
+    if ax is None:
+        fig, ax = plt.subplots(figsize=(6, 8))
+    else:
+        fig = ax.figure
+    pivot = np.abs(offsets).argmin()
+    peak = np.abs(xcf[pivot]).max()
+    xn = xcf / (peak if peak > 0 else 1.0)
+    ax.imshow(xn.T, aspect="auto", vmax=1.0, vmin=-1.0, cmap=cmap,
+              extent=[offsets[0], offsets[-1], lags[-1], lags[0]],
+              interpolation="bicubic")
+    ax.set_xlabel("Offset (m)")
+    ax.set_ylabel("Time lag (s)")
+    ax.set_xlim(list(x_lim))
+    ax.grid(True)
+    _save(fig, fig_path)
+    return ax
+
+
+def _norm_columns(fv: np.ndarray) -> np.ndarray:
+    m = fv.max(axis=0)
+    return fv / np.where(m != 0, m, 1.0)
+
+
+def apply_norm_part(fv: np.ndarray, freqs, vels, f_split: float = 10.0,
+                    v_split: float = 600.0) -> np.ndarray:
+    """The reference's norm_part block (modules/utils.py:528-543): after the
+    global per-frequency max-normalization, the (f > f_split, v > v_split)
+    quadrant is re-normalized *within itself* so weak high-mode energy
+    becomes visible.  Returns a new array (map layout: (nvel, nfreq),
+    velocity ascending)."""
+    fv, freqs, vels = _np(fv).copy(), _np(freqs), _np(vels)
+    hf = np.where(freqs > f_split)[0]
+    hv = np.where(vels > v_split)[0]
+    win = fv[np.ix_(hv, hf)]
+    win = _norm_columns(win)
+    fv = _norm_columns(fv)
+    fv[np.ix_(hv, hf)] = win
+    return fv
+
+
+def plot_fv_map(fv, freqs, vels, norm: bool = True, norm_part: bool = False,
+                ridge_data=None, ax=None, pclip: float = 98,
+                f_lim=(2.0, 25.0), v_lim=(250.0, 900.0),
+                fig_path: Optional[str] = None):
+    """Frequency-velocity dispersion image (reference plot_fv_map,
+    modules/utils.py:522-581): optional per-frequency max norm, optional
+    norm_part quadrant re-norm, jet colormap, percentile color clip, and
+    optional ridge-curve overlay ``ridge_data=(freq_lists, vel_lists)``."""
+    fv, freqs, vels = _np(fv), _np(freqs), _np(vels)
+    if norm_part:
+        fv = apply_norm_part(fv, freqs, vels)
+    elif norm:
+        fv = _norm_columns(fv)
+    if ax is None:
+        fig, ax = plt.subplots(figsize=(4.5, 3.5))
+    else:
+        fig = ax.figure
+    vmax = np.percentile(np.abs(fv), pclip)
+    vmin = np.percentile(np.abs(fv), 100 - pclip)
+    # imshow with origin-at-top extent [v0, v_end] reversed: put velocity
+    # ascending upward like the reference (extent bottom = vels[0])
+    ax.imshow(fv[::-1], aspect="auto",
+              extent=[freqs[0], freqs[-1], vels[0], vels[-1]],
+              cmap="jet", vmax=vmax, vmin=vmin)
+    if ridge_data is not None:
+        freq_r, vel_r = ridge_data
+        for fr, vr in zip(freq_r, vel_r):
+            ax.plot(_np(fr), _np(vr), "w.", alpha=0.5, markersize=5)
+    ax.grid(True)
+    ax.set_xlabel("Frequency (Hz)")
+    ax.set_ylabel("Phase velocity (m/s)")
+    ax.set_xlim(list(f_lim))
+    ax.set_ylim(list(v_lim))
+    _save(fig, fig_path)
+    return ax
+
+
+def plot_disp_curves(freqs, freq_lb, freq_ub, ridge_vels,
+                     errorbar_stride: int = 5, ax=None,
+                     f_lim=(2.0, 25.0), v_lim=(250.0, 900.0),
+                     fig_path: Optional[str] = None):
+    """Bootstrap dispersion curves with error bars (reference
+    plot_disp_curves, modules/utils.py:680-713): per band, every bootstrap
+    rep as a faint line plus mean +- std error bars every
+    ``errorbar_stride``-th frequency.  Returns (means, ranges, stds) lists
+    exactly like the reference."""
+    from das_diff_veh_tpu.inversion.curves import ridge_stats
+
+    freqs = _np(freqs)
+    if ax is None:
+        fig, ax = plt.subplots(figsize=(4.5, 3.5))
+    else:
+        fig = ax.figure
+    means, ranges, stds = [], [], []
+    for i, band in enumerate(ridge_vels):
+        fmask = (freqs >= freq_lb[i]) & (freqs < freq_ub[i])
+        f = freqs[fmask]
+        band = np.stack([_np(b).astype(np.float64) for b in band])
+        for rep in band:
+            ax.plot(f, rep, "-b", alpha=0.2, linewidth=1)
+        mean, rng, std = ridge_stats(band)
+        means.append(mean)
+        ranges.append(rng)
+        stds.append(std)
+        s = slice(None, None, errorbar_stride)
+        ax.errorbar(f[s], mean[s], yerr=std[s], fmt="ro", zorder=3,
+                    markersize=3, linewidth=2)
+    ax.grid(True)
+    ax.set_xlabel("Frequency (Hz)")
+    ax.set_ylabel("Phase velocity (m/s)")
+    ax.set_xlim(list(f_lim))
+    ax.set_ylim(list(v_lim))
+    _save(fig, fig_path)
+    return means, ranges, stds
+
+
+def save_class_figures(stack, lags, offsets, disp_image, freqs, vels,
+                       class_name: str, fig_dir: str, x0: float):
+    """Per-vehicle-class figure set (reference save_disp_imgs,
+    apis/imaging_classes.py:50-85): the class's averaged gather plus its
+    dispersion map with and without per-frequency normalization.  Writes
+    ``{fig_dir}/{x0}/sg_{class}_cars.pdf`` / ``disp_{class}_cars*.pdf``
+    (the reference's filenames)."""
+    base = os.path.join(fig_dir, str(int(x0)))
+    plot_gather(stack, lags, offsets,
+                fig_path=os.path.join(base, f"sg_{class_name}_cars.pdf"))
+    plot_fv_map(disp_image, freqs, vels, norm=False,
+                fig_path=os.path.join(base, f"disp_{class_name}_cars_no_norm.pdf"))
+    plot_fv_map(disp_image, freqs, vels, norm=True,
+                fig_path=os.path.join(base, f"disp_{class_name}_cars_no_enhance.pdf"))
+    return base
+
+
+def plot_model_ensemble(models_x, misfits, spec, max_depth_m: float = 150.0,
+                        top_frac: float = 0.3, ax=None,
+                        fig_path: Optional[str] = None):
+    """Vs-profile ensemble colored by misfit, with the best model and the
+    mean of the best ``top_frac`` highlighted (role of
+    inversion_diff_speed.ipynb cell 12's plot_model)."""
+    import jax.numpy as jnp
+
+    models_x, misfits = _np(models_x), _np(misfits)
+    if ax is None:
+        fig, ax = plt.subplots(figsize=(4, 6))
+    else:
+        fig = ax.figure
+    order = np.argsort(misfits)[::-1]          # worst first so best draws on top
+    fin = order[np.isfinite(misfits[order])]
+    lo, hi = misfits[fin[-1]], np.percentile(misfits[fin], 90)
+    cmap = plt.get_cmap("viridis_r")
+
+    def steps(x01):
+        m = spec.to_model(jnp.asarray(x01))
+        d = np.asarray(m.thickness)[:-1] * 1000.0
+        vs = np.asarray(m.vs) * 1000.0
+        tops = np.concatenate([[0.0], np.cumsum(d)])
+        z = np.repeat(tops, 2)[1:]
+        z = np.append(z, max_depth_m)
+        v = np.repeat(vs, 2)
+        return v, z
+
+    for i in fin:
+        v, z = steps(models_x[i])
+        c = cmap(float(np.clip((misfits[i] - lo) / max(hi - lo, 1e-12), 0, 1)))
+        ax.plot(v, z, color=c, alpha=0.25, linewidth=0.8)
+    # mean of best top_frac
+    k = max(1, int(len(fin) * top_frac))
+    best_set = fin[-k:]
+    vbar = np.mean([steps(models_x[i])[0] for i in best_set], axis=0)
+    _, zbar = steps(models_x[best_set[-1]])
+    ax.plot(vbar, zbar, "b-", linewidth=2, label=f"mean best {int(top_frac*100)}%")
+    vb, zb = steps(models_x[fin[-1]])
+    ax.plot(vb, zb, "r-", linewidth=2, label=f"best (misfit {misfits[fin[-1]]:.3f})")
+    ax.invert_yaxis()
+    ax.set_xlabel("Vs (m/s)")
+    ax.set_ylabel("Depth (m)")
+    ax.legend(fontsize=8)
+    ax.grid(True)
+    _save(fig, fig_path)
+    return ax
+
+
+def plot_sensitivity_kernels(kernels: Sequence, ax=None,
+                             fig_path: Optional[str] = None):
+    """Depth sensitivity kernels dc/dVs per period (role of
+    inversion_diff_weight.ipynb cells 19-20 PhaseSensitivity figures)."""
+    if ax is None:
+        fig, ax = plt.subplots(figsize=(4, 6))
+    else:
+        fig = ax.figure
+    for k in kernels:
+        ax.plot(_np(k.kernel), _np(k.depth) * 1000.0,
+                label=f"{1.0 / k.period:.1f} Hz")
+    ax.invert_yaxis()
+    ax.set_xlabel("dc/dVs")
+    ax.set_ylabel("Depth (m)")
+    ax.legend(fontsize=8)
+    ax.grid(True)
+    _save(fig, fig_path)
+    return ax
+
+
+def figure_set_from_synthetic(out_dir: str, n_windows: int = 16,
+                              seed: int = 0) -> list[str]:
+    """Produce the reference figure set from a synthetic run — the CLI's
+    ``--figures`` entry point.  Returns the list of files written."""
+    import jax.numpy as jnp
+
+    from das_diff_veh_tpu.config import DispersionConfig, GatherConfig
+    from das_diff_veh_tpu.models import vsg as V
+    from das_diff_veh_tpu.workloads import (make_gather_geometry,
+                                            make_window_batch)
+
+    gcfg, dcfg = GatherConfig(), DispersionConfig()
+    batch, x = make_window_batch(n_windows=n_windows, seed=seed)
+    g = make_gather_geometry(x)
+    gathers = V.build_gather_batch(batch, g, gcfg)
+    stack = V.stack_gathers(gathers, batch.valid)
+    offs = g.offsets(x)
+    img = V.gather_disp_image(stack, offs, g.dt, 8.16, dcfg, -150.0, 0.0)
+    freqs = np.arange(dcfg.freq_min, dcfg.freq_max, dcfg.freq_step)
+    vels = np.arange(dcfg.vel_min, dcfg.vel_max, dcfg.vel_step)
+
+    files = []
+
+    def out(name):
+        p = os.path.join(out_dir, name)
+        files.append(p)
+        return p
+
+    w0 = np.asarray(batch.data[0])
+    plot_waterfall(w0, x, np.asarray(batch.t[0]),
+                   fig_path=out("waterfall.png"))
+    ax = plot_waterfall(w0, x, np.asarray(batch.t[0]))
+    plot_windows(batch, ax=ax, fig_path=out("waterfall_windows.png"))
+    plot_gather(np.asarray(stack), g.lags(),
+                offs[: stack.shape[0]], fig_path=out("gather.png"))
+    plot_fv_map(np.asarray(img), freqs, vels, norm=True,
+                fig_path=out("fv_map.png"))
+    plot_fv_map(np.asarray(img), freqs, vels, norm_part=True,
+                fig_path=out("fv_map_norm_part.png"))
+    return files

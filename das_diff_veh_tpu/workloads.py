@@ -67,5 +67,5 @@ def make_window_batch(n_windows: int = 60, x0: float = 700.0,
 def make_gather_geometry(x: np.ndarray, x0: float = 700.0, fs: float = 250.0,
                          cfg: GatherConfig = GatherConfig()) -> VsgGeometry:
     """Reference gather geometry for a window batch: offsets start_x .. end_x
-    around the pivot (the notebooks' 700 m setup, x0-150 .. x0+75)."""
-    return VsgGeometry.build(x, 1.0 / fs, x0, x0 - 150.0, x0 + 75.0, cfg)
+    around the pivot (the notebooks' 700 m setup, x0-150 .. x0+far_offset)."""
+    return VsgGeometry.build(x, 1.0 / fs, x0, x0 - 150.0, x0 + cfg.far_offset, cfg)

@@ -6,9 +6,19 @@ build modal curves (bands 0/2/3 -> modes 0/3/4), invert with the TPU-batched
 swarm + optax refinement, and report the evodcinv-style weighted RMSE
 (reference best: 0.2210 speed classes / 0.1164 weight classes).
 
-Search runs on the default JAX device (TPU f32 under axon); the final best
-model is re-scored on CPU float64 against the *full-resolution* curves so
-the reported misfit is not a decimated or reduced-precision estimate.
+Precision policy: the process enables x64 so float64 stays float64 (the
+round-2 version silently downcast the final rescore to f32); the *search*
+runs in explicit float32 on the default JAX device (TPU under axon), and
+the final best model is re-scored in float64 on CPU against the
+full-resolution curves at tightened root-solve settings, so the reported
+misfit is neither decimated nor reduced-precision.
+
+Two final numbers per class:
+- ``misfit_f64_full``  — our objective (below-cutoff overtone samples carry
+  the fixed INVALID_RESIDUAL=5 penalty);
+- ``misfit_truncated`` — evodcinv's semantics (below-cutoff samples are
+  *dropped*, rmse over the surviving prefix), directly comparable to the
+  reference's 0.2210/0.1164, plus ``n_below_cutoff``.
 
 Usage: python scripts/inversion_parity.py [--quick] [--out FILE]
 """
@@ -21,16 +31,26 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
-from das_diff_veh_tpu.inversion import (curves_from_ridges,
+jax.config.update("jax_enable_x64", True)
+
+from das_diff_veh_tpu.cache import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache(_REPO)
+
+from das_diff_veh_tpu.inversion import (curves_from_ridges,  # noqa: E402
                                         load_reference_ridge_npz,
-                                        make_misfit_fn, invert,
+                                        invert, phase_velocity,
                                         speed_model_spec, weight_model_spec)
-from das_diff_veh_tpu.inversion.curves import Curve
+from das_diff_veh_tpu.inversion.curves import Curve  # noqa: E402
 
 REF_DATA = os.environ.get("DAS_REF_DATA", "/root/reference/data")
 
@@ -68,44 +88,107 @@ def build_curves(archive: str, key: str, rows, decimate: int = 1):
     return curves
 
 
+def rescore_f64(spec, curves, x_best, n_grid: int = 600):
+    """Float64 CPU rescoring of one model against full-resolution curves.
+
+    Returns (penalty_rmse, truncated_rmse, n_below_cutoff): the first uses
+    our INVALID_RESIDUAL=5 convention, the second drops below-cutoff points
+    like evodcinv truncates predicted curves — apples-to-apples with the
+    reference's recorded 0.2210 / 0.1164 misfits.  Both reuse
+    ``make_misfit_fn``'s two ``invalid`` modes so the reported score can
+    never drift from the search objective's semantics.
+    """
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        x = jnp.asarray(np.asarray(x_best, np.float64))
+        pen = float(make_misfit_fn(spec, curves, n_grid=n_grid, n_subdiv=3,
+                                   invalid="penalty")(x))
+        trunc = float(make_misfit_fn(spec, curves, n_grid=n_grid, n_subdiv=3,
+                                     invalid="truncate")(x))
+        # below-cutoff count from ONE concatenated forward call (same shape
+        # as the misfit's internal call -> shares its compiled executable)
+        model = spec.to_model(x)
+        period_all = jnp.asarray(np.concatenate([c.period for c in curves]))
+        mode_all = jnp.asarray(np.concatenate(
+            [np.full(len(c.period), c.mode) for c in curves]))
+        pred = phase_velocity(period_all, model, mode=mode_all,
+                              n_grid=n_grid, n_subdiv=3)
+        n_cut = int((~np.isfinite(np.asarray(pred))).sum())
+        return pen, trunc, n_cut
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--out", default="INVERSION_PARITY.json")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--maxrun", type=int, default=3,
+                    help="independent seeds per class, best kept — the "
+                         "reference's EarthModel.invert(maxrun=5) semantics")
     args = ap.parse_args()
 
-    popsize, maxiter, ref_steps = (24, 40, 40) if args.quick else (50, 300, 150)
+    popsize, maxiter, ref_steps = (24, 60, 40) if args.quick else (50, 300, 150)
+    run_cfg = {"popsize": popsize, "maxiter": maxiter,
+               "refine_steps": ref_steps, "seed": args.seed,
+               "maxrun": args.maxrun}
+    # resume: a crashed TPU worker kills the whole jax backend for this
+    # process, so recovery = rerun the script; completed cases of the SAME
+    # run config are skipped (a config change invalidates the partial file)
     results = {}
+    if os.path.exists(args.out + ".partial"):
+        with open(args.out + ".partial") as f:
+            prior = json.load(f)
+        if prior.get("config", {}) == run_cfg:
+            results = {k: v for k, v in prior.items()
+                       if isinstance(v, dict) and "misfit_f64_full" in v}
+            print(f"resuming; {len(results)} case(s) already done", flush=True)
+        else:
+            print("partial file is from a different config; starting fresh",
+                  flush=True)
+    t_all = time.time()
     for archive, key, spec_name, rows in CASES:
         spec = speed_model_spec() if spec_name == "speed" else weight_model_spec()
+        name = f"{archive.split('_')[0]}_{key.removeprefix('vels_')}_{spec_name}"
+        if name in results:
+            continue
         dec = build_curves(archive, key, rows, decimate=3)
         t0 = time.time()
-        res = invert(spec, dec, popsize=popsize, maxiter=maxiter,
-                     n_refine_starts=8, n_refine_steps=ref_steps,
-                     n_grid=300, seed=args.seed)
+        res = None
+        for run in range(args.maxrun):
+            r = invert(spec, dec, popsize=popsize, maxiter=maxiter,
+                       n_refine_starts=8, n_refine_steps=ref_steps,
+                       n_grid=300, dtype=jnp.float32, invalid="truncate",
+                       seed=args.seed + run)
+            print(f"  {name} run {run}: misfit {float(r.misfit):.4f}",
+                  flush=True)
+            if res is None or float(r.misfit) < float(res.misfit):
+                res = r
+        x_best = np.asarray(res.x_best, dtype=np.float64)
         search_t = time.time() - t0
-        # final f64 full-resolution scoring on CPU
         full = build_curves(archive, key, rows, decimate=1)
-        cpu = jax.devices("cpu")[0]
-        with jax.default_device(cpu):
-            mf64 = make_misfit_fn(spec, full, n_grid=600)
-            x = jax.device_put(np.asarray(res.x_best, dtype=np.float64), cpu)
-            final = float(mf64(x))
-        name = f"{archive.split('_')[0]}_{key.removeprefix('vels_')}_{spec_name}"
+        pen, trunc, n_cut = rescore_f64(spec, full, x_best)
         results[name] = {
-            "misfit_f64_full": final,
-            "misfit_search": float(res.misfit),
+            "misfit_f64_full": round(pen, 4),
+            "misfit_truncated": round(trunc, 4),
+            "n_below_cutoff": n_cut,
+            "misfit_search_f32": round(float(res.misfit), 4),
             "search_seconds": round(search_t, 1),
             "vs_km_s": np.asarray(res.model.vs).round(4).tolist(),
             "thickness_m": (np.asarray(res.model.thickness)[:-1]
                             * 1000).round(1).tolist(),
         }
         print(name, json.dumps(results[name]), flush=True)
+        with open(args.out + ".partial", "w") as f:
+            json.dump({**results, "config": run_cfg}, f, indent=1)
 
-    results["reference_best"] = {"speed": 0.2210, "weight": 0.1164}
+    results["reference_best"] = {"speed": 0.2210, "weight": 0.1164,
+                                 "minutes_per_class": "17-20 (evodcinv CPSO)"}
+    results["config"] = {**run_cfg, "device": str(jax.devices()[0]),
+                         "total_seconds": round(time.time() - t_all, 1)}
     with open(args.out, "w") as f:
         json.dump(results, f, indent=1)
+    if os.path.exists(args.out + ".partial"):
+        os.remove(args.out + ".partial")
     print("wrote", args.out)
 
 

@@ -3,6 +3,10 @@
 Multi-chip hardware is unavailable in CI; sharding paths are exercised on a
 fake 8-device CPU mesh exactly as the driver's dryrun does.  The session may
 export JAX_PLATFORMS=axon (single tunneled TPU chip) — tests override it.
+
+A persistent compilation cache is enabled: this host has a single slow CPU
+core and XLA backend compiles dominate the suite's first run (minutes per
+large graph); cached reruns skip them entirely.
 """
 
 import os
@@ -14,9 +18,13 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_ENABLE_X64"] = "1"
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
 
 import jax  # noqa: E402
 
+from das_diff_veh_tpu.cache import enable_compilation_cache  # noqa: E402
+
 jax.config.update("jax_enable_x64", True)
 jax.config.update("jax_platforms", "cpu")
+enable_compilation_cache(_REPO)

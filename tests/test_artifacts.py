@@ -1,0 +1,67 @@
+"""Artifact persistence: npz round-trips, reference schema compatibility,
+and the precomputed-gathers -> bootstrap cross-session path."""
+
+import numpy as np
+
+from das_diff_veh_tpu.io import artifacts as A
+
+RNG = np.random.default_rng(9)
+
+
+def test_gather_roundtrip_reference_schema(tmp_path):
+    xcf = RNG.standard_normal((28, 100)).astype(np.float32)
+    offs = np.linspace(-150.0, 70.0, 28)
+    lags = (np.arange(100) - 50) * 0.004
+    p = str(tmp_path / "gather.npz")
+    A.save_gather_npz(p, xcf, offs, lags)
+
+    # keys must match the reference loader (virtual_shot_gather.py:231-232)
+    f = np.load(p)
+    assert set(f.files) >= {"XCF_out", "x_axis", "t_axis"}
+
+    g = A.load_gather_npz(p)
+    np.testing.assert_array_equal(g.xcf, xcf)
+    np.testing.assert_array_equal(g.offsets, offs)
+    np.testing.assert_array_equal(g.lags, lags)
+
+
+def test_dispersion_roundtrip_reference_schema(tmp_path):
+    fv = RNG.standard_normal((50, 40))
+    freqs = np.arange(0.8, 4.8, 0.1)
+    vels = np.arange(200.0, 250.0)
+    p = str(tmp_path / "disp.npz")
+    A.save_dispersion_npz(p, fv, freqs, vels)
+    f = np.load(p)
+    assert set(f.files) == {"freqs", "vels", "fv_map"}
+    d = A.load_dispersion_npz(p)
+    np.testing.assert_array_equal(d.fv_map, fv)
+    np.testing.assert_array_equal(d.freqs, freqs)
+    np.testing.assert_array_equal(d.vels, vels)
+
+
+def test_window_gathers_roundtrip_and_bootstrap(tmp_path):
+    import jax.numpy as jnp
+
+    from das_diff_veh_tpu.analysis.bootstrap import bootstrap_disp, sample_indices
+    from das_diff_veh_tpu.config import BootstrapConfig, DispersionConfig
+
+    n_win, nch, wlen = 10, 19, 64
+    gathers = RNG.standard_normal((n_win, nch, wlen)).astype(np.float32)
+    valid = np.ones(n_win, bool)
+    offs = np.linspace(-150.0, 0.0, nch)
+    lags = (np.arange(wlen) - wlen // 2) * 0.004
+    p = str(tmp_path / "wg.npz")
+    A.save_window_gathers(p, gathers, valid, offs, lags)
+    art = A.load_window_gathers(p)
+    np.testing.assert_array_equal(art.gathers, gathers)
+    np.testing.assert_array_equal(art.valid, valid)
+
+    # the reloaded batch drives a bootstrap directly (cross-session path)
+    cfg = BootstrapConfig(bt_times=3, bt_size=4, freq_lb=(3.0,),
+                          freq_ub=(8.0,), sigma=(50.0,), ref_freq_idx=(30,))
+    dcfg = DispersionConfig(freq_step=0.2, vel_step=20.0)
+    idx = sample_indices(n_win, 4, 3, RNG)
+    ridges, freqs = bootstrap_disp(jnp.asarray(art.gathers), art.offsets,
+                                   0.004, 8.16, idx, cfg, dcfg)
+    assert ridges[0].shape[0] == 3
+    assert np.isfinite(ridges[0]).all()

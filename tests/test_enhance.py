@@ -1,0 +1,61 @@
+"""CLAHE f-v enhancement vs the real cv2 pipeline the reference uses
+(modules/utils.py:613-619: CLAHE(clip 100, tiles (100,10)) + 10x10 blur).
+
+cv2's CLAHE interpolation runs in fixed-point, so individual pixels can
+differ by a few gray levels; the assertions bound mean and tail error, and
+the box blur (which the reference always applies after) is checked to
++-1 level.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+cv2 = pytest.importorskip("cv2")
+
+from das_diff_veh_tpu.ops.enhance import box_blur_u8, clahe_u8, fv_map_enhance
+
+RNG = np.random.default_rng(7)
+
+
+def test_clahe_matches_cv2():
+    img = (np.abs(RNG.standard_normal((200, 121))) * 60).clip(0, 255).astype(np.uint8)
+    ref = cv2.createCLAHE(clipLimit=100.0, tileGridSize=(20, 5)).apply(img)
+    got = np.asarray(clahe_u8(jnp.asarray(img.astype(np.int32)), 100.0, (20, 5)))
+    d = np.abs(ref.astype(int) - got)
+    assert d.mean() < 2.0, d.mean()
+    assert (d > 5).mean() < 0.02, (d > 5).mean()
+
+
+def test_box_blur_matches_cv2():
+    img = RNG.integers(0, 256, size=(120, 90)).astype(np.uint8)
+    ref = cv2.blur(img, (10, 10))
+    got = np.asarray(box_blur_u8(jnp.asarray(img.astype(np.int32)), 10))
+    assert np.abs(ref.astype(int) - got).max() <= 1
+
+
+def test_full_enhance_matches_reference_pipeline():
+    # the exact reference chain (utils.py:613-619) on a dispersion-like map
+    fv = np.abs(RNG.standard_normal((250, 121))).astype(np.float64) + 0.05
+    fvn = (fv - fv.min()) / fv.max()
+    u8 = np.array(fvn * 255, dtype=np.uint8)
+    clahe = cv2.createCLAHE(clipLimit=100.0, tileGridSize=(25, 5))
+    ref = cv2.blur(clahe.apply(u8), (10, 10))
+
+    got = np.asarray(fv_map_enhance(jnp.asarray(fv), 100.0, (25, 5), 10))
+    d = np.abs(ref.astype(int) - got)
+    assert d.max() <= 6, d.max()
+    assert d.mean() < 1.0, d.mean()
+
+
+def test_enhance_flag_on_gather_disp_image():
+    from das_diff_veh_tpu.config import DispersionConfig
+    from das_diff_veh_tpu.models.vsg import gather_disp_image
+
+    xcf = jnp.asarray(RNG.standard_normal((30, 64)), jnp.float32)
+    offs = np.linspace(-150.0, 70.0, 30)
+    cfg = DispersionConfig(freq_step=0.5, vel_step=10.0)
+    img = gather_disp_image(xcf, offs, 0.004, 8.16, cfg, -150.0, 0.0,
+                            enhance=True)
+    a = np.asarray(img)
+    assert a.dtype == np.int32 and a.min() >= 0 and a.max() <= 255

@@ -101,14 +101,17 @@ class TestPhaseVelocity:
         model = speed_model_spec().to_model(jnp.full(12, 0.5))
         lo = 0.7 * float(model.vs.min())
         hi = 0.999 * float(model.vs[-1])
+        # one compiled scalar secular reused across every brentq call and
+        # every (mode, T) case (omega is a traced argument, not a constant)
+        sec = jax.jit(secular)
         for mode, T in [(0, 0.2), (0, 0.08), (1, 0.1), (3, 0.069),
                         (4, 0.055)]:
             om = 2 * np.pi / T
             cs = np.linspace(lo, hi, 4000)
-            Ds = np.asarray(jax.vmap(
-                lambda c: secular(c, om, model))(jnp.asarray(cs)))
+            Ds = np.asarray(sec(jnp.asarray(cs), jnp.asarray(om), model))
             flips = np.where(np.sign(Ds[:-1]) * np.sign(Ds[1:]) < 0)[0]
-            roots = [brentq(lambda c: float(secular(c, om, model)),
+            roots = [brentq(lambda c: float(sec(jnp.asarray(c),
+                                                jnp.asarray(om), model)),
                             cs[i], cs[i + 1]) for i in flips]
             mine = float(phase_velocity(jnp.asarray([T]), model, mode=mode,
                                         n_grid=300)[0])
@@ -182,8 +185,8 @@ class TestInvert:
             LayerBounds((0.01, 0.04), (0.25, 0.55)),
             LayerBounds((0.02, 0.08), (0.5, 1.0)),
         ))
-        res = invert(spec, curves, popsize=16, maxiter=25,
-                     n_refine_starts=3, n_refine_steps=40, n_grid=250,
+        res = invert(spec, curves, popsize=24, maxiter=100,
+                     n_refine_starts=4, n_refine_steps=50, n_grid=200,
                      seed=0)
         assert float(res.misfit) < 0.5  # well under 1 sigma per point
         np.testing.assert_allclose(np.asarray(res.model.vs), vs_true,

@@ -32,8 +32,8 @@ def test_process_chunk_xcorr(scene):
     assert img.shape == (1000, 242)
     assert np.isfinite(img).all()
     assert np.asarray(res.vsg_stack).ndim == 2
-    # quasi-static batch mirrors the surface-wave batch's window slots
-    assert bool((res.qs_batch.valid == res.batch.valid).all())
+    # raw-band windows are opt-in (nothing downstream consumes them here)
+    assert res.qs_batch is None
 
 
 def test_process_chunk_surface_wave(scene):
@@ -90,3 +90,41 @@ def test_cli_parser():
         ["--data_root", "/d", "--start_date", "20230301",
          "--end_date", "20230302", "--x0", "600"])
     assert args.x0 == 600.0 and args.method == "xcorr"
+
+
+def test_end_to_end_truth_recovery(scene):
+    """SURVEY §4 item 3: synthetic scene -> full pipeline -> physics.
+
+    (a) tracked vehicle speeds match the injected truth speeds;
+    (b) the stacked xcorr dispersion image's ridge matches the injected
+        phase-velocity curve c(f) over the usable band (interferometric
+        stacking needs multiple isolated vehicles, so a longer scene is
+        synthesized here; single-source gathers are biased).
+    """
+    from das_diff_veh_tpu.analysis.classify import vehicle_speeds
+    from das_diff_veh_tpu.io.synthetic import SceneConfig, synthesize_section
+
+    # --- (a) tracked speeds on the shared small scene ------------------------
+    section, truth = scene
+    res = process_chunk(section, _cfg(), method="xcorr", with_qs=True)
+    assert bool((res.qs_batch.valid == res.batch.valid).all())
+    speeds = np.asarray(vehicle_speeds(res.tracks))
+    got = speeds[np.asarray(res.tracks.valid) & np.isfinite(speeds)]
+    assert got.size >= 1
+    for s in got:
+        assert np.min(np.abs(truth.speed - s) / truth.speed) < 0.08, s
+
+    # --- (b) dispersion ridge vs injected c(f), many stacked windows ---------
+    cfg0 = SceneConfig(nch=100, duration=600.0, n_vehicles=14, seed=3,
+                       speed_range=(10.0, 20.0), noise_std=0.005)
+    big, big_truth = synthesize_section(cfg0)
+    res2 = process_chunk(big, _cfg(), method="xcorr")
+    assert res2.n_windows >= 5
+    img = np.asarray(res2.disp_image)
+    freqs = np.arange(0.8, 25, 0.1)
+    vels = np.arange(200.0, 1200.0, 1.0)
+    band = (freqs >= 3.0) & (freqs <= 10.0)
+    rec = vels[img[:, band].argmax(axis=0)]
+    c_true = big_truth.phase_velocity(freqs[band])
+    med_err = np.median(np.abs(rec - c_true) / c_true)
+    assert med_err < 0.12, med_err  # measured 0.056 on this scene

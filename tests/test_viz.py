@@ -1,0 +1,76 @@
+"""Visualization layer: figures render, files get written, and the
+norm_part re-normalization matches the reference algebra
+(modules/utils.py:528-543)."""
+
+import numpy as np
+import pytest
+
+import matplotlib
+matplotlib.use("Agg")
+
+from das_diff_veh_tpu import viz
+
+RNG = np.random.default_rng(3)
+
+
+def test_norm_part_matches_reference_algebra():
+    nf, nv = 40, 30
+    freqs = np.linspace(2.0, 25.0, nf)
+    vels = np.linspace(200.0, 1200.0, nv)
+    fv = np.abs(RNG.standard_normal((nv, nf))) + 0.1
+
+    got = viz.apply_norm_part(fv, freqs, vels)
+
+    # reference algebra (utils.py:528-543), written independently: global
+    # per-frequency max norm, then the (f>10, v>600) window re-normalized
+    # by its own per-frequency max
+    ref = fv / fv.max(axis=0)
+    hf = freqs > 10.0
+    hv = vels > 600.0
+    win = fv[np.ix_(np.where(hv)[0], np.where(hf)[0])]
+    ref[np.ix_(np.where(hv)[0], np.where(hf)[0])] = win / win.max(axis=0)
+    np.testing.assert_allclose(got, ref, rtol=1e-12)
+
+
+def test_plot_fv_map_and_gather_write_files(tmp_path):
+    fv = np.abs(RNG.standard_normal((50, 60)))
+    freqs = np.linspace(1.0, 25.0, 60)
+    vels = np.linspace(200.0, 1200.0, 50)
+    p1 = tmp_path / "fv.png"
+    viz.plot_fv_map(fv, freqs, vels, fig_path=str(p1))
+    assert p1.exists() and p1.stat().st_size > 0
+
+    xcf = RNG.standard_normal((28, 100))
+    lags = (np.arange(100) - 50) * 0.004
+    offs = np.linspace(-150.0, 70.0, 28)
+    p2 = tmp_path / "gather.png"
+    viz.plot_gather(xcf, lags, offs, fig_path=str(p2))
+    assert p2.exists() and p2.stat().st_size > 0
+
+
+def test_plot_disp_curves_returns_reference_stats(tmp_path):
+    freqs = np.linspace(1.0, 20.0, 50)
+    band = RNG.normal(400.0, 5.0, size=(8, np.sum((freqs >= 3) & (freqs < 9))))
+    means, ranges, stds = viz.plot_disp_curves(
+        freqs, [3.0], [9.0], [band], fig_path=str(tmp_path / "dc.png"))
+    np.testing.assert_allclose(means[0], band.mean(0))
+    np.testing.assert_allclose(ranges[0], band.max(0) - band.min(0))
+    np.testing.assert_allclose(stds[0], band.std(0))
+
+
+def test_model_ensemble_plot(tmp_path):
+    from das_diff_veh_tpu.inversion import speed_model_spec
+
+    spec = speed_model_spec()
+    X = RNG.uniform(0.2, 0.8, size=(20, 12))
+    mis = RNG.uniform(0.1, 2.0, size=20)
+    p = tmp_path / "ens.png"
+    viz.plot_model_ensemble(X, mis, spec, fig_path=str(p))
+    assert p.exists() and p.stat().st_size > 0
+
+
+def test_figure_set_from_synthetic(tmp_path):
+    files = viz.figure_set_from_synthetic(str(tmp_path), n_windows=3)
+    assert len(files) >= 5
+    for f in files:
+        assert (tmp_path / f.split("/")[-1]).exists()
