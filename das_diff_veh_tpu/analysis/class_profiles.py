@@ -1,0 +1,92 @@
+"""Per-class quasi-static time-series and PSD profiles.
+
+Library form of the notebook analysis cells the reference runs per vehicle
+class: the mean quasi-static deformation trace with a spread band
+(imaging_diff_speed.ipynb cell 11) and the per-class averaged Welch PSD with
+a min/max envelope (cells 16-18).  The per-vehicle signature is the same
+channel-mean -> Savitzky-Golay(101,3) -> detrend -> re-zero trace whose peak
+drives the weight classifier (cell 5).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from das_diff_veh_tpu.core.section import WindowBatch
+from das_diff_veh_tpu.ops.psd import welch_psd
+from das_diff_veh_tpu.ops.savgol import savgol_filter
+
+
+def quasi_static_signatures(qs_batch: WindowBatch, sg_window: int = 101,
+                            sg_order: int = 3) -> jnp.ndarray:
+    """Per-window quasi-static signature trace (nwin, nt): channel mean ->
+    SG(101,3) -> linear detrend -> re-zero at the first sample
+    (imaging_diff_speed.ipynb cell 5 — whose ``max|.|`` is the weight peak)."""
+    from das_diff_veh_tpu.ops.filters import detrend_linear
+
+    def one(data):
+        m = jnp.mean(data, axis=0)
+        sm = savgol_filter(m[None, :], sg_window, sg_order, axis=-1)[0]
+        d = detrend_linear(sm[None, :])[0]
+        return d - d[0]
+
+    sig = jax.vmap(one)(qs_batch.data)
+    return jnp.where(qs_batch.valid[:, None], sig, jnp.nan)
+
+
+def class_timeseries_stats(signatures, class_masks: Mapping[str, np.ndarray]
+                           ) -> Dict[str, Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Per-class (mean, std, 95% CI) over the vehicle axis of the signature
+    traces (imaging_diff_speed.ipynb cell 11).  Classes with no members map
+    to NaN arrays rather than raising."""
+    sig = np.asarray(signatures)
+    out = {}
+    for name, mask in class_masks.items():
+        mask = np.asarray(mask, dtype=bool)
+        rows = sig[mask]
+        rows = rows[np.isfinite(rows).all(axis=-1)] if rows.size else rows
+        if rows.shape[0] == 0:
+            nanrow = np.full(sig.shape[-1], np.nan)
+            out[name] = (nanrow, nanrow.copy(), nanrow.copy())
+            continue
+        mean = rows.mean(axis=0)
+        std = rows.std(axis=0)
+        # CI needs a sample-spread estimate: NaN for n=1 rather than a
+        # zero-width band implying perfect certainty
+        if rows.shape[0] > 1:
+            ci = 1.96 * rows.std(axis=0, ddof=1) / np.sqrt(rows.shape[0])
+        else:
+            ci = np.full(sig.shape[-1], np.nan)
+        out[name] = (mean, std, ci)
+    return out
+
+
+def class_psd(window_data, class_masks: Mapping[str, np.ndarray], fs: float,
+              nperseg: int = 2048):
+    """Per-class Welch PSD profile (imaging_diff_speed.ipynb cells 16-18).
+
+    ``window_data``: (nwin, nch, nt).  For each class: PSD per channel per
+    window (scipy-default Welch), mean over channels -> per-window PSDs, then
+    the class average — the reference's ``win_avg_psd`` restricted to the
+    class members.  Returns ``(freqs, {name: (avg, per_window)})``; empty
+    classes yield NaN avg and an empty per-window array.  Windows whose PSD
+    is non-finite (e.g. NaN-padded invalid batch slots caught by a too-wide
+    mask) are dropped per class rather than poisoning the average.
+    """
+    data = jnp.asarray(window_data)
+    freqs, p = welch_psd(data, fs, nperseg=nperseg)      # (nwin, nch, nf)
+    per_window = np.asarray(jnp.mean(p, axis=1))         # (nwin, nf)
+    freqs = np.asarray(freqs)
+    finite = np.isfinite(per_window).all(axis=-1)
+    out = {}
+    for name, mask in class_masks.items():
+        rows = per_window[np.asarray(mask, dtype=bool) & finite]
+        if rows.shape[0] == 0:
+            out[name] = (np.full(freqs.shape, np.nan), rows)
+        else:
+            out[name] = (rows.mean(axis=0), rows)
+    return freqs, out

@@ -12,7 +12,6 @@ import jax
 import jax.numpy as jnp
 
 from das_diff_veh_tpu.core.section import VehicleTracks, WindowBatch
-from das_diff_veh_tpu.ops.savgol import savgol_filter
 
 
 def vehicle_speeds(tracks: VehicleTracks) -> jnp.ndarray:
@@ -45,17 +44,10 @@ def quasi_static_peaks(qs_batch: WindowBatch, sg_window: int = 101,
     """Quasi-static load signature per window: channel-mean trace ->
     Savitzky-Golay(101,3) -> linear detrend -> re-zero at the first sample ->
     max |.| (imaging_diff_speed.ipynb cell 5).  NaN for invalid windows."""
-    from das_diff_veh_tpu.ops.filters import detrend_linear
+    from das_diff_veh_tpu.analysis.class_profiles import quasi_static_signatures
 
-    def one(data):
-        m = jnp.mean(data, axis=0)
-        sm = savgol_filter(m[None, :], sg_window, sg_order, axis=-1)[0]
-        d = detrend_linear(sm[None, :])[0]
-        d = d - d[0]
-        return jnp.max(jnp.abs(d))
-
-    peaks = jax.vmap(one)(qs_batch.data)
-    return jnp.where(qs_batch.valid, peaks, jnp.nan)
+    sig = quasi_static_signatures(qs_batch, sg_window=sg_window, sg_order=sg_order)
+    return jnp.max(jnp.abs(sig), axis=-1)   # NaN rows (invalid windows) stay NaN
 
 
 def _hist_mode(values: np.ndarray, bins: int = 100) -> float:

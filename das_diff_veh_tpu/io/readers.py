@@ -79,6 +79,25 @@ def read_sections(paths: Sequence[str], **kwargs) -> DasSection:
     return DasSection(np.concatenate(datas, axis=-1), x, np.concatenate(ts))
 
 
+def read_csv_section(data_dir: str, name: str) -> DasSection:
+    """Load the ``<name>.csv`` / ``<name>_x_axis.csv`` / ``<name>_t_axis.csv``
+    triplet used by the older tracking path (reference:
+    modules/car_tracking_utils.py:13-18 — space-delimited data matrix plus
+    one-column axis files; whitespace splitting so aligned/padded columns
+    read identically)."""
+    base = os.path.join(data_dir, name)
+    x = np.atleast_1d(np.genfromtxt(base + "_x_axis.csv", dtype=np.float64))
+    t = np.atleast_1d(np.genfromtxt(base + "_t_axis.csv", dtype=np.float64))
+    data = np.genfromtxt(base + ".csv", dtype=np.float64)
+    if data.ndim < 2 and data.size == x.size * t.size:
+        data = data.reshape(x.size, t.size)
+    data = np.atleast_2d(data)
+    if data.shape != (x.size, t.size):
+        raise ValueError(f"csv triplet {base}: data {data.shape} does not match "
+                         f"axes ({x.size} channels, {t.size} samples)")
+    return DasSection(data, np.atleast_1d(x), np.atleast_1d(t))
+
+
 def parse_time_from_filename(path: str, fmt: str = "%Y%m%d_%H%M%S") -> datetime:
     """Parse the acquisition timestamp from a file name
     (reference: modules/imaging_IO.py:17-20)."""

@@ -10,6 +10,7 @@ from das_diff_veh_tpu.ops.filters import (  # noqa: F401
 from das_diff_veh_tpu.ops.savgol import savgol_filter  # noqa: F401
 from das_diff_veh_tpu.ops.resample import resample_poly  # noqa: F401
 from das_diff_veh_tpu.ops.psd import welch_psd  # noqa: F401
+from das_diff_veh_tpu.ops.cwt import cwt_morlet, pick_travel_times  # noqa: F401
 from das_diff_veh_tpu.ops.qc import (  # noqa: F401
     noisy_trace_mask,
     empty_trace_mask,

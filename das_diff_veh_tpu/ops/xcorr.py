@@ -26,8 +26,12 @@ def sliding_windows(trace_or_data: jnp.ndarray, wlen: int, offset: int) -> jnp.n
     """Cut 1-D (or (nch, nt)) data into ``nwin`` windows of ``wlen`` samples
     every ``offset`` samples: returns (..., nwin, wlen).
 
-    Static starts -> a stack of static slices (zero-cost views), not a
-    gather: TPU gathers move ~0.4 GB/s while slices run at memory speed.
+    Static starts -> a stack of static slices (contiguous block copies), not
+    an elementwise gather: TPU gathers move ~0.4 GB/s while slice copies run
+    at memory speed.  The stack unrolls ``nwin`` slice ops into the traced
+    graph, so beyond a few hundred windows (continuous-record use, not the
+    ~15-window vehicle gathers this repo cuts) it falls back to the single
+    dynamic-slice formulation to keep trace/compile time bounded.
     """
     nt = trace_or_data.shape[-1]
     nwin = (nt - wlen) // offset + 1
@@ -36,6 +40,9 @@ def sliding_windows(trace_or_data: jnp.ndarray, wlen: int, offset: int) -> jnp.n
         # modules/utils.py:267)
         return jnp.zeros((*trace_or_data.shape[:-1], 0, wlen),
                          trace_or_data.dtype)
+    if nwin > 256:
+        starts = jnp.arange(0, nwin * offset, offset)
+        return cut_windows_at(trace_or_data, starts, wlen)
     return jnp.stack([trace_or_data[..., s:s + wlen]
                       for s in range(0, nwin * offset, offset)], axis=-2)
 

@@ -250,6 +250,64 @@ def save_class_figures(stack, lags, offsets, disp_image, freqs, vels,
     return base
 
 
+_CLASS_COLORS = {"slow": "b", "mid": "r", "fast": "k",
+                 "light": "b", "heavy": "k"}
+
+
+def plot_class_timeseries(t, stats, ax=None, band: str = "std",
+                          fig_path: Optional[str] = None):
+    """Per-class mean quasi-static trace with a spread band
+    (imaging_diff_speed.ipynb cell 11: mean line per class, ±std fill).
+
+    ``stats``: mapping class name -> (mean, std, ci) as produced by
+    ``analysis.class_profiles.class_timeseries_stats``; ``band`` picks the
+    fill half-width ("std" or "ci").
+    """
+    if band not in ("std", "ci"):
+        raise ValueError(f"band must be 'std' or 'ci', got {band!r}")
+    if ax is None:
+        _, ax = plt.subplots(figsize=(3, 3))
+    t = _np(t)
+    for i, (name, (mean, std, ci)) in enumerate(stats.items()):
+        color = _CLASS_COLORS.get(name, f"C{i}")
+        half = _np(ci if band == "ci" else std)
+        ax.plot(t, _np(mean), color, label=name)
+        ax.fill_between(t, _np(mean) - half, _np(mean) + half,
+                        color=color, alpha=0.1)
+    ax.set_xlabel("Time (s)")
+    ax.set_ylabel("DAS amplitude")
+    ax.legend()
+    _save(ax.figure, fig_path)
+    return ax
+
+
+def plot_class_psd(freqs, psds, ax=None, f_lo: float = 2.0, f_hi: float = 25.0,
+                   fig_path: Optional[str] = None):
+    """Per-class averaged Welch PSD (semilogy) with the min/max per-window
+    envelope, limited to [f_lo, f_hi] Hz (imaging_diff_speed.ipynb cell 18).
+
+    ``psds``: mapping class name -> (avg, per_window) as produced by
+    ``analysis.class_profiles.class_psd``.
+    """
+    if ax is None:
+        _, ax = plt.subplots(figsize=(5, 3.5))
+    freqs = _np(freqs)
+    sel = (freqs >= f_lo) & (freqs <= f_hi)
+    for i, (name, (avg, per_window)) in enumerate(psds.items()):
+        color = _CLASS_COLORS.get(name, f"C{i}")
+        ax.semilogy(freqs[sel], _np(avg)[sel], color, label=name)
+        per_window = _np(per_window)
+        if per_window.shape[0]:
+            ax.fill_between(freqs[sel], per_window.min(axis=0)[sel],
+                            per_window.max(axis=0)[sel], color=color, alpha=0.2)
+    ax.set_xlabel("Frequency (Hz)")
+    ax.set_ylabel("PSD ($A^2$/Hz)")
+    ax.set_xlim(f_lo, f_hi)
+    ax.legend()
+    _save(ax.figure, fig_path)
+    return ax
+
+
 def plot_model_ensemble(models_x, misfits, spec, max_depth_m: float = 150.0,
                         top_frac: float = 0.3, ax=None,
                         fig_path: Optional[str] = None):

@@ -1,0 +1,174 @@
+"""Tests: Morlet CWT + travel-time picker, per-class QS/PSD profiles, CSV reader."""
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+from scipy import signal as ssig
+
+from das_diff_veh_tpu.analysis.class_profiles import (class_psd,
+                                                      class_timeseries_stats,
+                                                      quasi_static_signatures)
+from das_diff_veh_tpu.analysis.classify import quasi_static_peaks
+from das_diff_veh_tpu.core.section import WindowBatch
+from das_diff_veh_tpu.io.readers import read_csv_section
+from das_diff_veh_tpu.ops.cwt import cwt_morlet, log_freqs, pick_travel_times
+
+
+def _tone_burst(nt, dt, f0, t_center, width):
+    t = np.arange(nt) * dt
+    return np.cos(2 * np.pi * f0 * (t - t_center)) * np.exp(
+        -0.5 * ((t - t_center) / width) ** 2)
+
+
+class TestCWT:
+    def test_peak_frequency_row_matches_tone(self):
+        dt, nt, f0 = 1 / 250.0, 2048, 8.0
+        x = _tone_burst(nt, dt, f0, nt * dt / 2, 0.5)
+        freqs = log_freqs(2.0, 20.0, 64)
+        mag = np.abs(np.asarray(cwt_morlet(jnp.asarray(x), 1 / dt, freqs)))
+        # frequency of the globally strongest coefficient ~ f0
+        fi, _ = np.unravel_index(np.argmax(mag), mag.shape)
+        assert abs(freqs[fi] - f0) / f0 < 0.1
+
+    def test_time_localization(self):
+        dt, nt, f0, tc = 1 / 250.0, 2048, 10.0, 3.1
+        x = _tone_burst(nt, dt, f0, tc, 0.3)
+        freqs = np.array([f0])
+        mag = np.abs(np.asarray(cwt_morlet(jnp.asarray(x), 1 / dt, freqs)))[0]
+        assert abs(np.argmax(mag) * dt - tc) < 0.1
+
+    def test_batch_matches_single(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((3, 512))
+        freqs = log_freqs(2, 12, 16)
+        batch = np.asarray(cwt_morlet(jnp.asarray(x), 250.0, freqs))
+        single = np.asarray(cwt_morlet(jnp.asarray(x[1]), 250.0, freqs))
+        np.testing.assert_allclose(batch[1], single, rtol=1e-5, atol=1e-8)
+
+    def test_picker_recovers_known_travel_times(self):
+        # gather layout: zero lag at nt//2; arrivals at +tau per trace
+        dt, nt, f0 = 1 / 250.0, 2000, 12.0
+        taus = np.array([0.4, 0.8, 1.6])
+        gather = np.stack([
+            _tone_burst(nt, dt, f0, nt // 2 * dt + tau, 0.15) for tau in taus])
+        times, f_used = pick_travel_times(jnp.asarray(gather), dt, pick_freq=f0)
+        assert abs(f_used - f0) < 0.5
+        np.testing.assert_allclose(np.asarray(times), taus, atol=0.05)
+
+
+def _qs_batch(rng, nwin=4, nch=6, nt=512):
+    data = rng.standard_normal((nwin, nch, nt)) * 0.01
+    # deterministic slow bump per window with distinct amplitude
+    t = np.linspace(0, 1, nt)
+    for w in range(nwin):
+        data[w] += (w + 1) * np.exp(-0.5 * ((t - 0.5) / 0.1) ** 2)[None, :]
+    valid = np.array([True] * (nwin - 1) + [False])
+    return WindowBatch(
+        data=jnp.asarray(data), x=jnp.arange(nch, dtype=jnp.float64),
+        t=jnp.asarray(np.broadcast_to(t, (nwin, nt)).copy()),
+        traj_x=jnp.zeros((nwin, 8)), traj_t=jnp.zeros((nwin, 8)),
+        valid=jnp.asarray(valid))
+
+
+class TestClassProfiles:
+    def test_signatures_shape_and_invalid_nan(self):
+        batch = _qs_batch(np.random.default_rng(1))
+        sig = np.asarray(quasi_static_signatures(batch))
+        assert sig.shape == (4, 512)
+        assert np.isnan(sig[-1]).all() and np.isfinite(sig[:-1]).all()
+        # amplitude ordering of the injected bumps survives the processing
+        peaks = np.asarray(quasi_static_peaks(batch))
+        assert peaks[0] < peaks[1] < peaks[2] and np.isnan(peaks[3])
+
+    def test_timeseries_stats(self):
+        batch = _qs_batch(np.random.default_rng(2))
+        sig = quasi_static_signatures(batch)
+        masks = {"light": np.array([1, 0, 0, 0], bool),
+                 "heavy": np.array([0, 1, 1, 1], bool),
+                 "none": np.zeros(4, bool)}
+        stats = class_timeseries_stats(sig, masks)
+        m, s, ci = stats["light"]
+        np.testing.assert_allclose(m, np.asarray(sig)[0], atol=1e-12)
+        assert np.allclose(s, 0)
+        assert np.isnan(ci).all()   # n=1: no honest CI, not a zero-width band
+        # invalid window 3 is NaN and must be dropped from "heavy", not poison it
+        assert np.isfinite(stats["heavy"][0]).all()
+        assert np.isnan(stats["none"][0]).all()
+
+    def test_class_psd_matches_scipy_welch(self):
+        rng = np.random.default_rng(3)
+        data = rng.standard_normal((3, 4, 1024))
+        masks = {"a": np.array([1, 1, 0], bool), "empty": np.zeros(3, bool)}
+        freqs, out = class_psd(data, masks, fs=250.0, nperseg=256)
+        f_ref, p_ref = ssig.welch(data[:2], 250.0, nperseg=256)
+        np.testing.assert_allclose(freqs, f_ref, atol=1e-12)
+        np.testing.assert_allclose(out["a"][0], p_ref.mean(axis=1).mean(axis=0),
+                                   rtol=1e-5)
+        assert np.isnan(out["empty"][0]).all()
+        assert out["empty"][1].shape[0] == 0
+
+    def test_class_plots_smoke(self, tmp_path):
+        batch = _qs_batch(np.random.default_rng(4))
+        from das_diff_veh_tpu.viz import plot_class_psd, plot_class_timeseries
+        sig = quasi_static_signatures(batch)
+        masks = {"light": np.array([1, 0, 0, 0], bool),
+                 "heavy": np.array([0, 1, 1, 0], bool)}
+        stats = class_timeseries_stats(sig, masks)
+        p1 = os.path.join(tmp_path, "ts.png")
+        plot_class_timeseries(np.asarray(batch.t)[0], stats, fig_path=p1)
+        freqs, psds = class_psd(np.asarray(batch.data), masks, fs=250.0,
+                                nperseg=256)
+        p2 = os.path.join(tmp_path, "psd.png")
+        plot_class_psd(freqs, psds, fig_path=p2)
+        assert os.path.getsize(p1) > 0 and os.path.getsize(p2) > 0
+
+
+class TestCSVReader:
+    def test_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(5)
+        data = rng.standard_normal((3, 7))
+        x = np.arange(3.0) * 8.16
+        t = np.arange(7.0) / 250.0
+        base = os.path.join(tmp_path, "drive")
+        np.savetxt(base + ".csv", data, delimiter=" ")
+        np.savetxt(base + "_x_axis.csv", x)
+        np.savetxt(base + "_t_axis.csv", t)
+        sec = read_csv_section(str(tmp_path), "drive")
+        np.testing.assert_allclose(np.asarray(sec.data), data, rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(sec.x), x)
+        np.testing.assert_allclose(np.asarray(sec.t), t)
+
+    def test_aligned_columns_and_single_sample(self, tmp_path):
+        # aligned/padded columns (multiple spaces) must not create phantom
+        # NaN columns; an (N, 1) triplet must reshape, not fail
+        base = os.path.join(tmp_path, "aligned")
+        with open(base + ".csv", "w") as f:
+            f.write("  1.0   -2.0\n 3.50   4.25\n")
+        np.savetxt(base + "_x_axis.csv", [0.0, 8.16])
+        np.savetxt(base + "_t_axis.csv", [0.0, 0.004])
+        sec = read_csv_section(str(tmp_path), "aligned")
+        np.testing.assert_allclose(np.asarray(sec.data),
+                                   [[1.0, -2.0], [3.5, 4.25]])
+        base = os.path.join(tmp_path, "col")
+        np.savetxt(base + ".csv", np.arange(3.0))
+        np.savetxt(base + "_x_axis.csv", np.arange(3.0))
+        np.savetxt(base + "_t_axis.csv", [0.0])
+        assert np.asarray(read_csv_section(str(tmp_path), "col").data).shape == (3, 1)
+
+    def test_class_psd_drops_nan_window(self):
+        data = np.random.default_rng(6).standard_normal((3, 2, 512))
+        data[2] = np.nan
+        freqs, out = class_psd(data, {"a": np.ones(3, bool)}, fs=250.0,
+                               nperseg=128)
+        assert np.isfinite(out["a"][0]).all()
+        assert out["a"][1].shape[0] == 2
+
+    def test_shape_mismatch_raises(self, tmp_path):
+        base = os.path.join(tmp_path, "bad")
+        np.savetxt(base + ".csv", np.zeros((3, 7)), delimiter=" ")
+        np.savetxt(base + "_x_axis.csv", np.zeros(2))
+        np.savetxt(base + "_t_axis.csv", np.zeros(7))
+        with pytest.raises(ValueError, match="does not match"):
+            read_csv_section(str(tmp_path), "bad")
