@@ -16,6 +16,7 @@ from das_diff_veh_tpu.inversion.forward import (LayeredModel,
                                                 secular, vp_from_poisson)
 from das_diff_veh_tpu.inversion.invert import (InversionResult, LayerBounds,
                                                ModelSpec, invert,
+                                               invert_multirun,
                                                make_misfit_fn,
                                                speed_model_spec,
                                                weight_model_spec)
@@ -27,7 +28,8 @@ __all__ = [
     "Curve", "curves_from_ridges", "load_reference_ridge_npz", "ridge_stats",
     "LayeredModel", "density_gardner_linear", "phase_velocity",
     "rayleigh_halfspace_velocity", "secular", "vp_from_poisson",
-    "InversionResult", "LayerBounds", "ModelSpec", "invert", "make_misfit_fn",
+    "InversionResult", "LayerBounds", "ModelSpec", "invert",
+    "invert_multirun", "make_misfit_fn",
     "speed_model_spec", "weight_model_spec",
     "SensitivityKernel", "phase_sensitivity", "resample_fine",
 ]

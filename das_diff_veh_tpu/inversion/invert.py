@@ -192,20 +192,36 @@ class InversionResult(NamedTuple):
     history: jnp.ndarray       # (iters,) best-so-far misfit trace
 
 
+def _eval_pop(misfit_fn, x, eval_chunk: int):
+    """Population misfits; ``eval_chunk > 0`` bounds how many evaluate
+    concurrently (lax.map over chunks) so batched-restart populations can't
+    exceed device memory — an outer run-axis vmap turns the chunk loop into
+    a (runs x eval_chunk) working set instead of (runs x popsize)."""
+    pop = x.shape[0]
+    if eval_chunk <= 0 or eval_chunk >= pop:
+        return jax.vmap(misfit_fn)(x)
+    pad = (-pop) % eval_chunk
+    xp = jnp.pad(x, ((0, pad), (0, 0)))
+    f = jax.lax.map(jax.vmap(misfit_fn),
+                    xp.reshape(-1, eval_chunk, x.shape[-1]))
+    return f.reshape(-1)[:pop]
+
+
 @partial(jax.jit, static_argnames=("misfit_fn", "n_params", "popsize",
-                                   "dtype"))
-def _pso_init(misfit_fn, key, n_params: int, popsize: int, dtype=None):
+                                   "dtype", "eval_chunk"))
+def _pso_init(misfit_fn, key, n_params: int, popsize: int, dtype=None,
+              eval_chunk: int = 0):
     dtype = dtype or jnp.zeros(()).dtype
     k1, k2 = jax.random.split(key)
     x = jax.random.uniform(k1, (popsize, n_params), dtype=dtype)
     v = 0.1 * (jax.random.uniform(k2, (popsize, n_params), dtype=dtype) - 0.5)
-    f = jax.vmap(misfit_fn)(x)
+    f = _eval_pop(misfit_fn, x, eval_chunk)
     g = jnp.argmin(f)
     return (x, v, x, f, x[g], f[g])
 
 
-@partial(jax.jit, static_argnames=("misfit_fn", "n_iters"))
-def _pso_run(misfit_fn, state, key, n_iters: int):
+@partial(jax.jit, static_argnames=("misfit_fn", "n_iters", "eval_chunk"))
+def _pso_run(misfit_fn, state, key, n_iters: int, eval_chunk: int = 0):
     """``n_iters`` inertial global-best PSO steps on the unit cube (w=0.73,
     c1=c2=1.496 - the constriction coefficients the reference's stochopy
     CPSO also defaults to), velocities clamped, positions clipped."""
@@ -220,7 +236,7 @@ def _pso_run(misfit_fn, state, key, n_iters: int):
              + c2 * r1[1] * (gbest_x[None] - x))
         v = jnp.clip(v, -0.25, 0.25)
         x = jnp.clip(x + v, 0.0, 1.0)
-        f = jax.vmap(misfit_fn)(x)
+        f = _eval_pop(misfit_fn, x, eval_chunk)
         better = f < pbest_f
         pbest_x = jnp.where(better[:, None], x, pbest_x)
         pbest_f = jnp.where(better, f, pbest_f)
@@ -325,3 +341,66 @@ def invert(spec: ModelSpec, curves: Sequence[Curve], *, popsize: int = 50,
     return InversionResult(
         model=spec.to_model(x_best), misfit=all_f[best], x_best=x_best,
         models_x=all_x, misfits=all_f, history=trace)
+
+
+def invert_multirun(spec: ModelSpec, curves: Sequence[Curve], *,
+                    n_runs: int = 3, popsize: int = 50, maxiter: int = 200,
+                    n_refine_starts: int = 8, n_refine_steps: int = 80,
+                    n_grid: int = 400, n_subdiv: int = 1, dtype=None,
+                    invalid: str = "penalty", seed: int = 0,
+                    chunk: int = 50, eval_chunk: int = 0,
+                    refine_chunk: int = 0) -> InversionResult:
+    """Best-of-``n_runs`` inversion with every run's swarm advanced in ONE
+    batched computation (``vmap`` over the run axis).
+
+    The reference's ``maxrun`` restarts execute serially (evodcinv
+    EarthModel.invert(maxrun=5), inversion_diff_speed.ipynb cell 9); here a
+    population of ``n_runs x popsize`` misfits evaluates per iteration in
+    one device program, so N restarts cost roughly ONE run's wall-clock on
+    an accelerator with headroom.  Refinement then pools the top basins of
+    *all* runs into a single vectorised multi-start Adam batch.
+
+    ``eval_chunk``/``refine_chunk`` bound the concurrent misfit / gradient
+    evaluations per device call (0 = unbounded): with ``n_runs`` swarms the
+    working set is runs x eval_chunk, which keeps big restart counts inside
+    HBM on a single chip.
+    """
+    misfit_fn = make_misfit_fn(spec, curves, n_grid=n_grid,
+                               n_subdiv=n_subdiv, dtype=dtype,
+                               invalid=invalid)
+    keys = jax.vmap(jax.random.PRNGKey)(seed + jnp.arange(n_runs))
+    init = partial(_pso_init, misfit_fn, n_params=spec.n_params,
+                   popsize=popsize, dtype=dtype, eval_chunk=eval_chunk)
+    states = jax.vmap(lambda k: init(k))(keys)
+    traces, done = [], 0
+    while done < maxiter:
+        n = min(chunk, maxiter - done)
+        step_keys = jax.vmap(lambda k: jax.random.fold_in(k, 7 + done))(keys)
+        states, tr = jax.vmap(
+            lambda st, k: _pso_run(misfit_fn, st, k, n,
+                                   eval_chunk=eval_chunk))(states, step_keys)
+        traces.append(tr)
+        done += n
+    _, _, pop_x, pop_f, gbest_x, gbest_f = states   # leading axis: run
+
+    k = min(n_refine_starts, popsize)
+    top = jnp.argsort(pop_f, axis=1)[:, :k]                      # (runs, k)
+    starts = jnp.concatenate(
+        [gbest_x[:, None], jnp.take_along_axis(pop_x, top[..., None], axis=1)],
+        axis=1).reshape(-1, spec.n_params)                       # pooled
+    if refine_chunk and refine_chunk < starts.shape[0]:
+        parts = [_refine(misfit_fn, starts[i:i + refine_chunk], n_refine_steps)
+                 for i in range(0, starts.shape[0], refine_chunk)]
+        ref_x = jnp.concatenate([p[0] for p in parts], axis=0)
+        ref_f = jnp.concatenate([p[1] for p in parts], axis=0)
+    else:
+        ref_x, ref_f = _refine(misfit_fn, starts, n_refine_steps)
+
+    all_x = jnp.concatenate([pop_x.reshape(-1, spec.n_params), ref_x], axis=0)
+    all_f = jnp.concatenate([pop_f.reshape(-1), ref_f], axis=0)
+    best = jnp.argmin(all_f)
+    x_best = all_x[best]
+    return InversionResult(
+        model=spec.to_model(x_best), misfit=all_f[best], x_best=x_best,
+        models_x=all_x, misfits=all_f,
+        history=jnp.min(jnp.concatenate(traces, axis=-1), axis=0))

@@ -29,12 +29,16 @@ from das_diff_veh_tpu.ops.peaks import find_peaks, gaussian_likelihood
 
 
 def detect_vehicle_base(data: jnp.ndarray, t_axis: jnp.ndarray,
-                        start_x_idx: int, cfg: TrackingConfig = TrackingConfig()):
+                        start_x_idx: int, cfg: TrackingConfig = TrackingConfig(),
+                        return_details: bool = False):
     """Stacked-likelihood vehicle arrival detection over ``n_detect_channels``
     consecutive channels at the section start (reference
     detect_in_one_section, apis/tracking.py:21-63).
 
-    Returns (base_idx (max_vehicles,) int32, valid (max_vehicles,)).
+    Returns (base_idx (max_vehicles,) int32, valid (max_vehicles,)); with
+    ``return_details`` also the intermediates the reference's detection
+    example figure shows (detection rows, per-row peaks, stacked likelihood
+    — apis/tracking.py:47-60,197-237), consumed by ``viz.plot_detection``.
     """
     det = cfg.detect
     rows = jax.lax.dynamic_slice_in_dim(data, start_x_idx, cfg.n_detect_channels, 0)
@@ -48,6 +52,8 @@ def detect_vehicle_base(data: jnp.ndarray, t_axis: jnp.ndarray,
     # maxima + distance pruning only
     base, valid = find_peaks(stacked, min_distance=det.min_separation,
                              max_peaks=cfg.max_vehicles, use_prominence=False)
+    if return_details:
+        return base, valid, (rows, pk_pos, pk_valid, stacked)
     return base, valid
 
 

@@ -194,10 +194,19 @@ def xcorr_all_pairs_peak(data: jnp.ndarray, wlen: int,
     """
     wf = _window_spectra(data, wlen, overlap_ratio)
     use_p = _decide_pallas(wf.shape[0], use_pallas)
+    return peak_from_spectra(wf, wf, wlen, src_chunk, use_p, interpret)
+
+
+def peak_from_spectra(wf_src, wf_all, wlen: int, src_chunk: int,
+                      use_pallas: bool, interpret: bool = False):
+    """Peak |xcorr| of every ``wf_src`` row against every ``wf_all`` row:
+    (nsrc, nall) float32.  Split out so a sharded caller
+    (``parallel.allpairs``) can hand each device its own source-row block
+    while the receiver side stays the full spectra set."""
 
     def finish(src_rows):
-        spec = _cross_spectra(src_rows, wf, use_p, interpret)
+        spec = _cross_spectra(src_rows, wf_all, use_pallas, interpret)
         c = jnp.fft.irfft(spec, n=wlen, axis=-1)
         return jnp.max(jnp.abs(c), axis=-1)
 
-    return _chunked(wf, src_chunk, finish)
+    return _chunked(wf_src, src_chunk, finish)

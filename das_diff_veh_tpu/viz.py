@@ -250,6 +250,43 @@ def save_class_figures(stack, lags, offsets, disp_image, freqs, vels,
     return base
 
 
+def plot_detection(data, t, start_x_idx: int, cfg=None, ax=None,
+                   fig_path: Optional[str] = None):
+    """Detection example: the ``n_detect_channels`` traces (vertically
+    offset) with their picked peaks, the stacked Gaussian likelihood below,
+    and the detected vehicle bases (reference show_detection_example /
+    detect_in_one_section(show_plot=True), apis/tracking.py:47-60,197-237).
+    """
+    import jax.numpy as jnp
+
+    from das_diff_veh_tpu.config import TrackingConfig
+    from das_diff_veh_tpu.models.tracking import detect_vehicle_base
+
+    cfg = cfg or TrackingConfig()
+    base, valid, (rows, pk_pos, pk_valid, stacked) = detect_vehicle_base(
+        jnp.asarray(data), jnp.asarray(t), start_x_idx, cfg,
+        return_details=True)
+    rows, pk_pos, pk_valid = _np(rows), _np(pk_pos), _np(pk_valid)
+    stacked, base, valid, t = _np(stacked), _np(base), _np(valid), _np(t)
+    if ax is None:
+        _, ax = plt.subplots(figsize=(6, 5))
+    span = max(np.nanmax(np.abs(rows)), 1e-12)
+    for i, row in enumerate(rows):
+        off = (i + 1) * 2 * span
+        ax.plot(t, row + off, "k", lw=0.5)
+        pk = pk_pos[i][pk_valid[i]]
+        ax.plot(t[pk], row[pk] + off, "rx", markersize=4)
+    lk = stacked / max(stacked.max(), 1e-12) * span
+    ax.plot(t, lk, "b", label="stacked likelihood")
+    bv = base[valid]
+    ax.plot(t[bv], lk[bv], "g^", markersize=8, label="vehicle base")
+    ax.set_xlabel("Time (s)")
+    ax.set_yticks([])
+    ax.legend(loc="upper right")
+    _save(ax.figure, fig_path)
+    return ax
+
+
 _CLASS_COLORS = {"slow": "b", "mid": "r", "fast": "k",
                  "light": "b", "heavy": "k"}
 

@@ -72,3 +72,17 @@ def test_pallas_peak_interpret():
     b = np.asarray(xcorr_all_pairs_peak(d, wlen, use_pallas=True,
                                         interpret=True, src_chunk=4))
     np.testing.assert_allclose(b, a, rtol=2e-4, atol=2e-5)
+
+
+def test_sharded_all_pairs_matches_single_device():
+    # 8-virtual-device CPU mesh; 26 channels exercises the pad/trim path
+    from das_diff_veh_tpu.parallel import make_mesh, sharded_all_pairs_peak
+
+    rng = np.random.default_rng(4)
+    data = jnp.asarray(rng.standard_normal((26, 512)).astype(np.float32))
+    mesh = make_mesh(8)
+    got = np.asarray(sharded_all_pairs_peak(data, 128, mesh,
+                                            use_pallas=False))
+    want = np.asarray(xcorr_all_pairs_peak(data, 128, use_pallas=False))
+    assert got.shape == (26, 26)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)

@@ -115,7 +115,9 @@ def test_end_to_end_truth_recovery(scene):
         assert np.min(np.abs(truth.speed - s) / truth.speed) < 0.08, s
 
     # --- (b) dispersion ridge vs injected c(f), many stacked windows ---------
-    cfg0 = SceneConfig(nch=100, duration=600.0, n_vehicles=14, seed=3,
+    # smallest scene that keeps >=5 isolated windows and a ~4x margin on the
+    # ridge assertion (probed: med_err 0.026 vs the 0.12 threshold)
+    cfg0 = SceneConfig(nch=100, duration=300.0, n_vehicles=8, seed=3,
                        speed_range=(10.0, 20.0), noise_std=0.005)
     big, big_truth = synthesize_section(cfg0)
     res2 = process_chunk(big, _cfg(), method="xcorr")
@@ -127,4 +129,4 @@ def test_end_to_end_truth_recovery(scene):
     rec = vels[img[:, band].argmax(axis=0)]
     c_true = big_truth.phase_velocity(freqs[band])
     med_err = np.median(np.abs(rec - c_true) / c_true)
-    assert med_err < 0.12, med_err  # measured 0.056 on this scene
+    assert med_err < 0.12, med_err  # measured 0.026 on this scene

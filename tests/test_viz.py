@@ -74,3 +74,19 @@ def test_figure_set_from_synthetic(tmp_path):
     assert len(files) >= 5
     for f in files:
         assert (tmp_path / f.split("/")[-1]).exists()
+
+
+def test_plot_detection_writes_file(tmp_path):
+    # a few pulse trains -> traces with clear peaks and a stacked likelihood
+    rng = np.random.default_rng(7)
+    fs, dur = 50.0, 40.0
+    t = np.arange(int(dur * fs)) / fs
+    nch = 15
+    data = rng.standard_normal((nch + 4, t.size)) * 0.01
+    for arr in (8.0, 22.0):
+        for c in range(4, 4 + nch):
+            data[c] += np.exp(-0.5 * ((t - arr) / 0.15) ** 2)
+    p = str(tmp_path / "det.png")
+    viz.plot_detection(data, t, start_x_idx=4, fig_path=p)
+    import os
+    assert os.path.getsize(p) > 0
