@@ -7,6 +7,8 @@ from das_diff_veh_tpu.analysis.classify import (  # noqa: F401
     majority_weight_mask, quasi_static_peaks, vehicle_speeds)
 from das_diff_veh_tpu.analysis.class_profiles import (  # noqa: F401
     class_psd, class_timeseries_stats, quasi_static_signatures)
+from das_diff_veh_tpu.analysis.classed import (  # noqa: F401
+    ClassedAnalysis, class_stacks, classed_analysis)
 from das_diff_veh_tpu.analysis.ridge import extract_ridge  # noqa: F401
 from das_diff_veh_tpu.analysis.bootstrap import (  # noqa: F401
     bootstrap_disp, convergence_test, sample_indices)

@@ -1,0 +1,90 @@
+"""Classed vehicle analysis: the imaging_diff_{speed,weight} notebook flow
+as one driver.
+
+Reference flow (imaging_diff_speed.ipynb cells 5-18 / imaging_diff_weight
+cells 5-18): per-vehicle quasi-static peak signature -> majority filter on
+the *other* attribute (weight mode +-0.3 sigma for the speed study, speed
+mean +- sigma for the weight study) -> three classes (speed: mean +- sigma;
+weight: 1.2 / histogram-mode thresholds) -> per-class quasi-static
+time-series stats and averaged Welch PSD.  Window-batch rows map 1:1 to
+tracked vehicles (models.windows.select_windows), so speed (from tracks) and
+weight (from qs windows) signatures align by row index.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Mapping, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from das_diff_veh_tpu.analysis import classify as C
+from das_diff_veh_tpu.analysis.class_profiles import (class_psd,
+                                                      class_timeseries_stats,
+                                                      quasi_static_signatures)
+from das_diff_veh_tpu.core.section import VehicleTracks, WindowBatch
+
+
+@dataclass
+class ClassedAnalysis:
+    """Per-class masks + profiles for one chunk's vehicles."""
+
+    masks: Dict[str, np.ndarray]          # class name -> (max_windows,) bool
+    majority: np.ndarray                  # majority-filter mask (pre-split)
+    speeds: np.ndarray                    # (max_windows,) m/s (NaN invalid)
+    peaks: np.ndarray                     # (max_windows,) qs peak (NaN invalid)
+    signatures: np.ndarray                # (max_windows, nt_win)
+    ts_stats: Mapping[str, Tuple[np.ndarray, np.ndarray, np.ndarray]]
+    psd_freqs: np.ndarray
+    psd: Mapping[str, Tuple[np.ndarray, np.ndarray]]
+
+
+def classed_analysis(qs_batch: WindowBatch, tracks: VehicleTracks, *,
+                     by: str = "speed", fs: float = 250.0,
+                     nperseg: int = 2048,
+                     heavy_threshold: float = 1.2) -> ClassedAnalysis:
+    """Run the classed-analysis flow on one chunk's raw-band windows + tracks.
+
+    ``by="speed"``: majority-weight filter, then fast/mid/slow split
+    (imaging_diff_speed.ipynb cells 5-8).  ``by="weight"``: majority-speed
+    filter, then heavy/mid/light split (imaging_diff_weight.ipynb cells 5-8).
+    Profiles (cells 11, 16-18) are computed for the resulting classes.
+    """
+    assert by in ("speed", "weight")
+    sig = quasi_static_signatures(qs_batch)
+    peaks = np.asarray(jnp.max(jnp.abs(sig), axis=-1))
+    speeds = np.asarray(C.vehicle_speeds(tracks))
+    speeds = np.where(np.asarray(qs_batch.valid), speeds, np.nan)
+
+    if by == "speed":
+        majority = C.majority_weight_mask(peaks)
+        split = np.where(majority, speeds, np.nan)
+        fast, mid, slow = C.classify_by_speed(split)
+        masks = {"fast": fast, "mid": mid, "slow": slow}
+    else:
+        majority = C.majority_speed_mask(speeds)
+        split = np.where(majority, peaks, np.nan)
+        heavy, mid, light = C.classify_by_weight(
+            split, heavy_threshold=heavy_threshold)
+        masks = {"heavy": heavy, "mid": mid, "light": light}
+
+    ts_stats = class_timeseries_stats(sig, masks)
+    freqs, psd = class_psd(np.asarray(qs_batch.data), masks, fs,
+                           nperseg=min(nperseg, qs_batch.data.shape[-1]))
+    return ClassedAnalysis(masks=masks, majority=np.asarray(majority),
+                           speeds=speeds, peaks=peaks,
+                           signatures=np.asarray(sig), ts_stats=ts_stats,
+                           psd_freqs=np.asarray(freqs), psd=psd)
+
+
+def class_stacks(per_window: jnp.ndarray, valid,
+                 masks: Mapping[str, np.ndarray]) -> Dict[str, jnp.ndarray]:
+    """Masked-mean stack of any per-window tensor (gathers or dispersion
+    images) per class — the aggregation inside the reference's
+    ``save_disp_imgs`` (apis/imaging_classes.py:50-85)."""
+    from das_diff_veh_tpu.models.vsg import stack_gathers
+
+    valid = jnp.asarray(valid)
+    return {name: stack_gathers(per_window, valid & jnp.asarray(mask))
+            for name, mask in masks.items()}

@@ -250,24 +250,6 @@ def _pso_run(misfit_fn, state, key, n_iters: int, eval_chunk: int = 0):
     return jax.lax.scan(step, state, keys)
 
 
-def _pso(misfit_fn, key, n_params: int, popsize: int, maxiter: int,
-         dtype=None, chunk: int = 50):
-    """PSO driver: the iteration loop runs as host-chunked device calls of
-    ``chunk`` scan steps each — one compiled step body regardless of
-    maxiter, bounded single-call device time (long monolithic scans have
-    crashed the tunneled-TPU worker), and a natural progress boundary."""
-    state = _pso_init(misfit_fn, key, n_params, popsize, dtype)
-    traces = []
-    done = 0
-    while done < maxiter:
-        n = min(chunk, maxiter - done)
-        state, tr = _pso_run(misfit_fn, state, jax.random.fold_in(key, 7 + done), n)
-        traces.append(tr)
-        done += n
-    x, v, pbest_x, pbest_f, gbest_x, gbest_f = state
-    return gbest_x, gbest_f, pbest_x, pbest_f, jnp.concatenate(traces)
-
-
 @partial(jax.jit, static_argnames=("misfit_fn", "n_steps", "lr"))
 def _refine_run(misfit_fn, z, opt_state, n_steps: int, lr: float):
     opt = optax.adam(lr)
@@ -321,26 +303,16 @@ def invert(spec: ModelSpec, curves: Sequence[Curve], *, popsize: int = 50,
     whole population evaluates as one batched forward solve per iteration
     and a gradient stage polishes the best basins (far fewer forward
     evaluations for the same or better final misfit).
+
+    One machine, two entry points: this is :func:`invert_multirun` with a
+    single restart (same RNG stream as seed ``seed``, same pooling), kept as
+    the stable per-run unit the parity script's serial mode loops over.
     """
-    misfit_fn = make_misfit_fn(spec, curves, n_grid=n_grid,
-                               n_subdiv=n_subdiv, dtype=dtype,
-                               invalid=invalid)
-    key = jax.random.PRNGKey(seed)
-    gbest_x, gbest_f, pop_x, pop_f, trace = _pso(
-        misfit_fn, key, spec.n_params, popsize, maxiter, dtype=dtype)
-
-    k = min(n_refine_starts, popsize)
-    top = jnp.argsort(pop_f)[:k]
-    starts = jnp.concatenate([gbest_x[None], pop_x[top]], axis=0)
-    ref_x, ref_f = _refine(misfit_fn, starts, n_refine_steps)
-
-    all_x = jnp.concatenate([pop_x, ref_x], axis=0)
-    all_f = jnp.concatenate([pop_f, ref_f], axis=0)
-    best = jnp.argmin(all_f)
-    x_best = all_x[best]
-    return InversionResult(
-        model=spec.to_model(x_best), misfit=all_f[best], x_best=x_best,
-        models_x=all_x, misfits=all_f, history=trace)
+    return invert_multirun(spec, curves, n_runs=1, popsize=popsize,
+                           maxiter=maxiter, n_refine_starts=n_refine_starts,
+                           n_refine_steps=n_refine_steps, n_grid=n_grid,
+                           n_subdiv=n_subdiv, dtype=dtype, invalid=invalid,
+                           seed=seed)
 
 
 def invert_multirun(spec: ModelSpec, curves: Sequence[Curve], *,

@@ -50,14 +50,17 @@ def sharded_all_pairs_peak(data: jnp.ndarray, wlen: int, mesh: Mesh, *,
     pad = (-nch) % n_dev
     dpad = jnp.pad(data, ((0, pad), (0, 0)))
     use_p = _decide_pallas(nch, use_pallas)
+    # windowed spectra once, outside the shard: each device then receives its
+    # source-row slice plus the replicated full set (recomputing inside the
+    # shard would run the full-set rfft n_dev times)
+    wf = _window_spectra(dpad, wlen, overlap_ratio)
 
-    @partial(shard_map, mesh=mesh, in_specs=(P(axis, None), P(None, None)),
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(axis, None, None), P(None, None, None)),
              out_specs=P(axis, None))
-    def run(src_rows, all_data):
-        wf_all = _window_spectra(all_data, wlen, overlap_ratio)
-        wf_src = _window_spectra(src_rows, wlen, overlap_ratio)
+    def run(wf_src, wf_all):
         return peak_from_spectra(wf_src, wf_all, wlen, src_chunk, use_p,
                                  interpret)
 
-    out = run(dpad, dpad)
+    out = run(wf, wf)
     return out[:nch, :nch]

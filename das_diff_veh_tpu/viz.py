@@ -20,6 +20,12 @@ Mirrors (semantics, not code) the reference's figure set:
 - per-class figures ``save_class_figures`` <- save_disp_imgs,
   apis/imaging_classes.py:50-85 (gather + norm/no-norm f-v figures per
   vehicle class)
+- detection example ``plot_detection`` <- show_detection_example,
+  apis/tracking.py:197-237
+- gather spectra ``plot_psd_vs_offset`` / ``plot_spectrum_vs_offset``
+  <- apis/virtual_shot_gather.py:45-109
+- per-class profiles ``plot_class_timeseries`` / ``plot_class_psd``
+  <- imaging_diff_speed.ipynb cells 11, 18
 - inversion ensemble ``plot_model_ensemble`` <- inversion_diff_speed.ipynb
   cell 12 role (profiles colored by misfit, best model highlighted)
 
@@ -132,6 +138,57 @@ def plot_gather(xcf, lags, offsets, ax=None, cmap="seismic",
     ax.set_xlim(list(x_lim))
     ax.grid(True)
     _save(fig, fig_path)
+    return ax
+
+
+def plot_psd_vs_offset(xcf, offsets, dt, fhi: float = 20.0, pclip: float = 98,
+                       log_scale: bool = False, nperseg: int = 256,
+                       nfft: int = 1024, ax=None,
+                       fig_path: Optional[str] = None):
+    """Welch PSD of each gather trace vs offset, imaged to ``fhi`` Hz with
+    pclip color limits (reference plot_psd_vs_offset,
+    apis/virtual_shot_gather.py:45-90; optional 10*log10 dB scale)."""
+    import jax.numpy as jnp
+
+    from das_diff_veh_tpu.ops.psd import welch_psd
+
+    xcf, offsets = _np(xcf), _np(offsets)
+    freqs, p = welch_psd(jnp.asarray(xcf), 1.0 / dt, nperseg=nperseg,
+                         nfft=nfft)
+    freqs, p = _np(freqs), _np(p)
+    sel = freqs < fhi
+    spec = p[:, sel]
+    if log_scale:
+        spec = 10.0 * np.log10(np.maximum(spec, 1e-30))
+    vmax = np.percentile(spec, pclip)
+    vmin = np.percentile(spec, 100 - pclip)
+    if ax is None:
+        _, ax = plt.subplots(figsize=(8, 8))
+    ax.imshow(spec.T, extent=[offsets[0], offsets[-1],
+                              freqs[sel][-1], freqs[0]],
+              cmap="jet", aspect="auto", vmax=vmax, vmin=vmin)
+    ax.set_xlabel("Distance along the fiber [m]")
+    ax.set_ylabel("Frequency [Hz]")
+    _save(ax.figure, fig_path)
+    return ax
+
+
+def plot_spectrum_vs_offset(xcf, offsets, dt, fhi: float = 20.0, ax=None,
+                            fig_path: Optional[str] = None):
+    """FFT amplitude of each gather trace vs offset to ``fhi`` Hz
+    (reference plot_spectrum_vs_offset, apis/virtual_shot_gather.py:93-109)."""
+    xcf, offsets = _np(xcf), _np(offsets)
+    freqs = np.fft.rfftfreq(xcf.shape[-1], d=dt)
+    sel = freqs < fhi
+    spec = np.abs(np.fft.rfft(xcf, axis=-1))[:, sel]
+    if ax is None:
+        _, ax = plt.subplots(figsize=(8, 8))
+    ax.imshow(spec.T, extent=[offsets[0], offsets[-1],
+                              freqs[sel][-1], freqs[0]],
+              cmap="jet", aspect="auto")
+    ax.set_xlabel("Distance along the fiber [m]")
+    ax.set_ylabel("Frequency [Hz]")
+    _save(ax.figure, fig_path)
     return ax
 
 

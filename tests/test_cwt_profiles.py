@@ -172,3 +172,63 @@ class TestCSVReader:
         np.savetxt(base + "_t_axis.csv", np.zeros(7))
         with pytest.raises(ValueError, match="does not match"):
             read_csv_section(str(tmp_path), "bad")
+
+
+class TestClassedAnalysis:
+    def _scene(self, bumps, speeds_mps):
+        from das_diff_veh_tpu.core.section import VehicleTracks
+        nveh, nch, nt = len(bumps), 6, 1024
+        fs, dt_track = 250.0, 0.02
+        t = np.arange(nt) / fs
+        rng = np.random.default_rng(8)
+        data = rng.standard_normal((nveh, nch, nt)) * 0.01
+        for w, b in enumerate(bumps):
+            data[w] += b * np.exp(-0.5 * ((t - 2.0) / 0.3) ** 2)[None, :]
+        batch = WindowBatch(
+            data=jnp.asarray(data), x=jnp.arange(nch, dtype=jnp.float64),
+            t=jnp.asarray(np.broadcast_to(t, (nveh, nt)).copy()),
+            traj_x=jnp.zeros((nveh, 4)), traj_t=jnp.zeros((nveh, 4)),
+            valid=jnp.ones(nveh, bool))
+        x_track = np.arange(50.0)
+        t_idx = np.stack([x_track / (v * dt_track) for v in speeds_mps])
+        tracks = VehicleTracks(t_idx=jnp.asarray(t_idx),
+                               valid=jnp.ones(nveh, bool),
+                               x=jnp.asarray(x_track),
+                               t=jnp.arange(2000.0) * dt_track)
+        return batch, tracks
+
+    def test_by_speed_with_weight_outlier(self):
+        from das_diff_veh_tpu.analysis import classed_analysis
+        bumps = [1.0, 1.05, 0.95, 3.0, 1.0, 1.02, 0.98, 1.0]
+        speeds = [20.0, 20.0, 15.0, 15.0, 15.0, 15.0, 10.0, 10.0]
+        batch, tracks = self._scene(bumps, speeds)
+        res = classed_analysis(batch, tracks, by="speed", fs=250.0,
+                               nperseg=256)
+        assert not res.majority[3]          # weight outlier filtered out
+        assert res.masks["fast"].sum() == 2 and res.masks["slow"].sum() == 2
+        assert res.masks["mid"].sum() == 3  # vehicle 3 excluded from mid
+        np.testing.assert_allclose(res.speeds[:2], 20.0, rtol=0.02)
+        for name in res.masks:
+            assert np.isfinite(res.ts_stats[name][0]).all()
+            assert np.isfinite(res.psd[name][0]).all()
+
+    def test_by_weight(self):
+        from das_diff_veh_tpu.analysis import classed_analysis
+        bumps = [1.5, 1.6, 0.5, 0.52, 0.48, 0.5, 0.9, 0.92]
+        speeds = [15.0] * 8
+        batch, tracks = self._scene(bumps, speeds)
+        res = classed_analysis(batch, tracks, by="weight", fs=250.0,
+                               nperseg=256)
+        assert res.masks["heavy"].sum() == 2
+        assert (res.masks["heavy"] & np.array([1, 1, 0, 0, 0, 0, 0, 0],
+                                              bool)).sum() == 2
+
+    def test_class_stacks_masked_mean(self):
+        from das_diff_veh_tpu.analysis import class_stacks
+        per_win = jnp.asarray(np.arange(8, dtype=np.float64)[:, None, None]
+                              * np.ones((8, 3, 4)))
+        valid = np.array([1, 1, 1, 1, 1, 1, 1, 0], bool)
+        masks = {"a": np.array([1, 1, 0, 0, 0, 0, 0, 1], bool)}
+        out = class_stacks(per_win, valid, masks)
+        # window 7 is invalid: mean over {0, 1} only
+        np.testing.assert_allclose(np.asarray(out["a"]), 0.5)

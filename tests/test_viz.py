@@ -90,3 +90,16 @@ def test_plot_detection_writes_file(tmp_path):
     viz.plot_detection(data, t, start_x_idx=4, fig_path=p)
     import os
     assert os.path.getsize(p) > 0
+
+
+def test_gather_spectra_plots_write_files(tmp_path):
+    rng = np.random.default_rng(9)
+    xcf = rng.standard_normal((30, 500))
+    offs = np.linspace(-150.0, 0.0, 30)
+    p1 = str(tmp_path / "psd_off.png")
+    p2 = str(tmp_path / "spec_off.png")
+    viz.plot_psd_vs_offset(xcf, offs, dt=1 / 250.0, log_scale=True,
+                           fig_path=p1)
+    viz.plot_spectrum_vs_offset(xcf, offs, dt=1 / 250.0, fig_path=p2)
+    import os
+    assert os.path.getsize(p1) > 0 and os.path.getsize(p2) > 0
