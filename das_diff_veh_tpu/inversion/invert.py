@@ -273,7 +273,8 @@ def _refine(misfit_fn, x0_batch, n_steps: int, lr: float = 0.02,
             chunk: int = 50):
     """Vectorised multi-start Adam in logit space (keeps iterates strictly
     inside the box while gradients stay unconstrained).  Host-chunked like
-    :func:`_pso` to bound single device-call time."""
+    the PSO loop in :func:`invert_multirun` to bound single device-call
+    time (long monolithic scans have crashed the tunneled-TPU worker)."""
     eps = 1e-4
     z = jax.scipy.special.logit(jnp.clip(x0_batch, eps, 1.0 - eps))
     opt_state = jax.vmap(optax.adam(lr).init)(z)
@@ -295,7 +296,7 @@ def invert(spec: ModelSpec, curves: Sequence[Curve], *, popsize: int = 50,
            maxiter: int = 200, n_refine_starts: int = 8,
            n_refine_steps: int = 80, n_grid: int = 400,
            n_subdiv: int = 1, dtype=None, invalid: str = "penalty",
-           seed: int = 0) -> InversionResult:
+           seed: int = 0, misfit_fn=None) -> InversionResult:
     """Swarm search + gradient refinement for a 1-D Vs profile.
 
     Matches the role of ``EarthModel.invert(curves, maxrun=5)`` with CPSO
@@ -312,7 +313,7 @@ def invert(spec: ModelSpec, curves: Sequence[Curve], *, popsize: int = 50,
                            maxiter=maxiter, n_refine_starts=n_refine_starts,
                            n_refine_steps=n_refine_steps, n_grid=n_grid,
                            n_subdiv=n_subdiv, dtype=dtype, invalid=invalid,
-                           seed=seed)
+                           seed=seed, misfit_fn=misfit_fn)
 
 
 def invert_multirun(spec: ModelSpec, curves: Sequence[Curve], *,
@@ -321,7 +322,7 @@ def invert_multirun(spec: ModelSpec, curves: Sequence[Curve], *,
                     n_grid: int = 400, n_subdiv: int = 1, dtype=None,
                     invalid: str = "penalty", seed: int = 0,
                     chunk: int = 50, eval_chunk: int = 0,
-                    refine_chunk: int = 0) -> InversionResult:
+                    refine_chunk: int = 0, misfit_fn=None) -> InversionResult:
     """Best-of-``n_runs`` inversion with every run's swarm advanced in ONE
     batched computation (``vmap`` over the run axis).
 
@@ -336,10 +337,16 @@ def invert_multirun(spec: ModelSpec, curves: Sequence[Curve], *,
     evaluations per device call (0 = unbounded): with ``n_runs`` swarms the
     working set is runs x eval_chunk, which keeps big restart counts inside
     HBM on a single chip.
+
+    ``misfit_fn``: optional prebuilt objective (from :func:`make_misfit_fn`)
+    — pass the SAME function object across repeated calls so the jitted
+    swarm/refine executables (keyed on its identity) are traced once; the
+    parity script's serial mode uses this to avoid re-tracing per restart.
     """
-    misfit_fn = make_misfit_fn(spec, curves, n_grid=n_grid,
-                               n_subdiv=n_subdiv, dtype=dtype,
-                               invalid=invalid)
+    if misfit_fn is None:
+        misfit_fn = make_misfit_fn(spec, curves, n_grid=n_grid,
+                                   n_subdiv=n_subdiv, dtype=dtype,
+                                   invalid=invalid)
     keys = jax.vmap(jax.random.PRNGKey)(seed + jnp.arange(n_runs))
     init = partial(_pso_init, misfit_fn, n_params=spec.n_params,
                    popsize=popsize, dtype=dtype, eval_chunk=eval_chunk)

@@ -451,6 +451,25 @@ def plot_model_ensemble(models_x, misfits, spec, max_depth_m: float = 150.0,
     return ax
 
 
+def plot_convergence(spreads, ax=None, fig_path: Optional[str] = None):
+    """Bootstrap ridge spread vs sample count per mode
+    (imaging_diff_speed.ipynb cell 31's convergence figure).  ``spreads``:
+    (n_modes, max_sample_num) from ``analysis.bootstrap.convergence_test``.
+    """
+    spreads = _np(spreads)
+    if ax is None:
+        _, ax = plt.subplots(figsize=(5, 3.5))
+    n = np.arange(1, spreads.shape[1] + 1)
+    for m, row in enumerate(spreads):
+        ax.plot(n, row, label=f"mode band {m}")
+    ax.set_xlabel("Bootstrap sample count")
+    ax.set_ylabel("Summed ridge std (m/s)")
+    ax.legend(fontsize=8)
+    ax.grid(True)
+    _save(ax.figure, fig_path)
+    return ax
+
+
 def plot_sensitivity_kernels(kernels: Sequence, ax=None,
                              fig_path: Optional[str] = None):
     """Depth sensitivity kernels dc/dVs per period (role of
@@ -510,4 +529,10 @@ def figure_set_from_synthetic(out_dir: str, n_windows: int = 16,
                 fig_path=out("fv_map.png"))
     plot_fv_map(np.asarray(img), freqs, vels, norm_part=True,
                 fig_path=out("fv_map_norm_part.png"))
+    dt = float(g.dt)
+    nch_plot = min(stack.shape[0], len(offs))
+    plot_psd_vs_offset(np.asarray(stack)[:nch_plot], offs[:nch_plot], dt,
+                       log_scale=True, fig_path=out("gather_psd_offset.png"))
+    plot_spectrum_vs_offset(np.asarray(stack)[:nch_plot], offs[:nch_plot],
+                            dt, fig_path=out("gather_spectrum_offset.png"))
     return files

@@ -103,3 +103,11 @@ def test_gather_spectra_plots_write_files(tmp_path):
     viz.plot_spectrum_vs_offset(xcf, offs, dt=1 / 250.0, fig_path=p2)
     import os
     assert os.path.getsize(p1) > 0 and os.path.getsize(p2) > 0
+
+
+def test_plot_convergence_writes_file(tmp_path):
+    spreads = np.abs(np.random.default_rng(11).standard_normal((3, 20)))
+    p = str(tmp_path / "conv.png")
+    viz.plot_convergence(spreads, fig_path=p)
+    import os
+    assert os.path.getsize(p) > 0
