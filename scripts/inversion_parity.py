@@ -183,8 +183,10 @@ def main():
         prior_cfg = {k: v for k, v in prior_all.get("config", {}).items()
                      if k in ("popsize", "maxiter", "refine_steps", "seed",
                               "maxrun")}
+        # the global block only reliably describes the LAST invocation, so a
+        # backfilled per-class config is a best guess, marked as such
         for v in merged.values():
-            v.setdefault("search_config", prior_cfg)
+            v.setdefault("search_config", {**prior_cfg, "assumed": True})
     t_all = time.time()
     for archive, key, spec_name, rows in CASES:
         spec = speed_model_spec() if spec_name == "speed" else weight_model_spec()
@@ -236,6 +238,19 @@ def main():
             with open(args.out + ".partial", "w") as f:
                 json.dump({**results, "config": run_cfg}, f, indent=1)
             continue
+        # keep-best keys on evodcinv's truncated RMSE (the reference's own
+        # scoring, which drops below-cutoff overtone samples).  That metric
+        # rewards models whose overtones vanish at scored periods, so when a
+        # challenger wins WHILE invalidating more samples than the incumbent,
+        # the incumbent survives inside the entry as the full-coverage
+        # alternate instead of being silently discarded.
+        alternate = None
+        if (args.merge and name in merged
+                and n_cut > merged[name].get("n_below_cutoff", 0)):
+            alternate = {k: merged[name][k] for k in
+                         ("misfit_f64_full", "misfit_truncated",
+                          "n_below_cutoff", "vs_km_s", "thickness_m")
+                         if k in merged[name]}
         results[name] = {
             "misfit_f64_full": round(pen, 4),
             "misfit_truncated": round(trunc, 4),
@@ -245,8 +260,12 @@ def main():
             "vs_km_s": np.asarray(res.model.vs).round(4).tolist(),
             "thickness_m": (np.asarray(res.model.thickness)[:-1]
                             * 1000).round(1).tolist(),
+            "x_best": x_best.round(6).tolist(),   # unit-cube params: lets a
+            # later run warm-start/re-polish without re-searching
             "search_config": run_cfg,   # per-class: merge reruns may escalate
         }
+        if alternate is not None:
+            results[name]["full_coverage_alternate"] = alternate
         print(name, json.dumps(results[name]), flush=True)
         with open(args.out + ".partial", "w") as f:
             json.dump({**results, "config": run_cfg}, f, indent=1)

@@ -114,6 +114,23 @@ def test_end_to_end_truth_recovery(scene):
     for s in got:
         assert np.min(np.abs(truth.speed - s) / truth.speed) < 0.08, s
 
+    # pipeline -> classed-analysis integration (notebook cells 5-18 flow on
+    # real pipeline outputs): masks partition the majority set, profiles
+    # are finite for non-empty classes
+    from das_diff_veh_tpu.analysis import classed_analysis
+
+    ca = classed_analysis(res.qs_batch, res.tracks, by="weight", fs=250.0,
+                          nperseg=512)
+    union = np.zeros_like(ca.majority)
+    for name, mask in ca.masks.items():
+        assert not (union & mask).any()          # classes are disjoint
+        union |= mask
+        if mask.any():
+            assert np.isfinite(ca.ts_stats[name][0]).all()
+            assert np.isfinite(ca.psd[name][0]).all()
+    valid = np.asarray(res.qs_batch.valid)
+    assert (union <= (ca.majority & valid)).all()
+
     # --- (b) dispersion ridge vs injected c(f), many stacked windows ---------
     # smallest scene that keeps >=5 isolated windows and a ~4x margin on the
     # ridge assertion (probed: med_err 0.026 vs the 0.12 threshold)
