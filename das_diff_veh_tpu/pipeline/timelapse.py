@@ -71,10 +71,13 @@ def disp_image_batch(batch: WindowBatch, cfg: PipelineConfig) -> jnp.ndarray:
                          norm=dcfg.norm, sg_window=dcfg.sg_window,
                          sg_order=dcfg.sg_order)
 
-    # lax.map (not vmap): the per-window transform is gather-heavy and a
-    # 64-way batched program segfaults the XLA CPU compiler; the mapped body
-    # compiles once and loops
-    return jax.lax.map(one, (batch.data, batch.t, batch.traj_x, batch.traj_t))
+    # TPU: one batched program (vmap) — windows image in parallel.  CPU: a
+    # 64-way batched version of this gather-heavy transform segfaults the
+    # XLA CPU compiler, so the mapped body compiles once and loops.
+    args = (batch.data, batch.t, batch.traj_x, batch.traj_t)
+    if jax.default_backend() == "tpu":
+        return jax.vmap(one)(args)
+    return jax.lax.map(one, args)
 
 
 def process_chunk(section: DasSection, cfg: PipelineConfig = PipelineConfig(),
