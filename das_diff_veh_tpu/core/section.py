@@ -55,6 +55,14 @@ class DasSection:
     def numpy(self) -> "DasSection":
         return DasSection(np.asarray(self.data), np.asarray(self.x), np.asarray(self.t))
 
+    def cut_time(self, t1: float, t2: float) -> "DasSection":
+        """Slice to the [t1, t2) time range by nearest sample (reference
+        ``cut_data_along_time``, modules/utils.py:131-134)."""
+        t = np.asarray(self.t)
+        i1 = int(np.abs(t1 - t).argmin())
+        i2 = int(np.abs(t2 - t).argmin())
+        return DasSection(self.data[:, i1:i2], self.x, self.t[i1:i2])
+
 
 @_register
 @dataclass

@@ -71,11 +71,11 @@ def disp_image_batch(batch: WindowBatch, cfg: PipelineConfig) -> jnp.ndarray:
                          norm=dcfg.norm, sg_window=dcfg.sg_window,
                          sg_order=dcfg.sg_order)
 
-    # TPU: one batched program (vmap) — windows image in parallel.  CPU: a
-    # 64-way batched version of this gather-heavy transform segfaults the
-    # XLA CPU compiler, so the mapped body compiles once and loops.
+    # accelerators: one batched program (vmap) — windows image in parallel.
+    # CPU: a 64-way batched version of this gather-heavy transform segfaults
+    # the XLA CPU compiler, so the mapped body compiles once and loops.
     args = (batch.data, batch.t, batch.traj_x, batch.traj_t)
-    if jax.default_backend() == "tpu":
+    if jax.default_backend() not in ("cpu",):
         return jax.vmap(one)(args)
     return jax.lax.map(one, args)
 

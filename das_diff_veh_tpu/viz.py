@@ -141,6 +141,24 @@ def plot_gather(xcf, lags, offsets, ax=None, cmap="seismic",
     return ax
 
 
+def plot_fk(fk_mag, freqs, ks, f_max: float = 20.0, k_max: float = 0.04,
+            ax=None, fig_path: Optional[str] = None):
+    """f-k magnitude image, positive-quadrant view (reference plot_fk /
+    compute_and_plot_fk, modules/utils.py:225-234; the view limits are the
+    reference's hardcoded defaults, exposed as arguments)."""
+    fk_mag, freqs, ks = _np(fk_mag), _np(freqs), _np(ks)
+    if ax is None:
+        _, ax = plt.subplots(figsize=(8, 8))
+    ax.imshow(fk_mag.T, extent=[ks[0], ks[-1], freqs[-1], freqs[0]],
+              aspect="auto")
+    ax.set_ylim(0, f_max)
+    ax.set_xlim(0, k_max)
+    ax.set_xlabel("Wavenumber (1/m)")
+    ax.set_ylabel("Frequency (Hz)")
+    _save(ax.figure, fig_path)
+    return ax
+
+
 def plot_psd_vs_offset(xcf, offsets, dt, fhi: float = 20.0, pclip: float = 98,
                        log_scale: bool = False, nperseg: int = 256,
                        nfft: int = 1024, ax=None,

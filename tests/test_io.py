@@ -92,3 +92,13 @@ def test_dispersive_shot_moveout():
     p_near = np.argmax(np.abs(d[1]))
     p_far = np.argmax(np.abs(d[30]))
     assert p_far > p_near
+
+
+def test_cut_time_nearest_sample():
+    from das_diff_veh_tpu.core.section import DasSection
+    t = np.arange(1000) / 250.0
+    data = np.arange(3000, dtype=float).reshape(3, 1000)
+    sec = DasSection(data, np.arange(3.0), t).cut_time(0.5012, 2.0)
+    # nearest-index semantics of the reference cut_data_along_time
+    assert sec.t[0] == t[125] and sec.t.shape[0] == 500 - 125
+    np.testing.assert_allclose(np.asarray(sec.data), data[:, 125:500])

@@ -111,3 +111,15 @@ def test_plot_convergence_writes_file(tmp_path):
     viz.plot_convergence(spreads, fig_path=p)
     import os
     assert os.path.getsize(p) > 0
+
+
+def test_plot_fk_writes_file(tmp_path):
+    from das_diff_veh_tpu.ops.dispersion import fk_transform
+    import jax.numpy as jnp
+    rng = np.random.default_rng(12)
+    data = rng.standard_normal((30, 400))
+    mag, f, k = fk_transform(jnp.asarray(data), dx=8.16, dt=1 / 250.0)
+    p = str(tmp_path / "fk.png")
+    viz.plot_fk(np.asarray(mag), np.asarray(f), np.asarray(k), fig_path=p)
+    import os
+    assert os.path.getsize(p) > 0
