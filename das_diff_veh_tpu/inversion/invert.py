@@ -322,7 +322,8 @@ def invert_multirun(spec: ModelSpec, curves: Sequence[Curve], *,
                     n_grid: int = 400, n_subdiv: int = 1, dtype=None,
                     invalid: str = "penalty", seed: int = 0,
                     chunk: int = 50, eval_chunk: int = 0,
-                    refine_chunk: int = 0, misfit_fn=None) -> InversionResult:
+                    refine_chunk: int = 0, misfit_fn=None,
+                    mesh=None, mesh_axis: str = "win") -> InversionResult:
     """Best-of-``n_runs`` inversion with every run's swarm advanced in ONE
     batched computation (``vmap`` over the run axis).
 
@@ -342,15 +343,34 @@ def invert_multirun(spec: ModelSpec, curves: Sequence[Curve], *,
     — pass the SAME function object across repeated calls so the jitted
     swarm/refine executables (keyed on its identity) are traced once; the
     parity script's serial mode uses this to avoid re-tracing per restart.
+
+    ``mesh``: optional ``jax.sharding.Mesh`` — the run axis of the swarm
+    state shards over ``mesh_axis`` and each device advances its own
+    restarts with no cross-device traffic until the final pooling (restarts
+    are independent; ``n_runs`` should be a device-count multiple for even
+    placement).  Results are independent of the sharding.
     """
     if misfit_fn is None:
         misfit_fn = make_misfit_fn(spec, curves, n_grid=n_grid,
                                    n_subdiv=n_subdiv, dtype=dtype,
                                    invalid=invalid)
     keys = jax.vmap(jax.random.PRNGKey)(seed + jnp.arange(n_runs))
+
+    def _shard_runs(tree):
+        if mesh is None:
+            return tree
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def place(a):
+            spec_ = P(*((mesh_axis,) + (None,) * (a.ndim - 1)))
+            return jax.device_put(a, NamedSharding(mesh, spec_))
+
+        return jax.tree.map(place, tree)
+
+    keys = _shard_runs(keys)
     init = partial(_pso_init, misfit_fn, n_params=spec.n_params,
                    popsize=popsize, dtype=dtype, eval_chunk=eval_chunk)
-    states = jax.vmap(lambda k: init(k))(keys)
+    states = _shard_runs(jax.vmap(lambda k: init(k))(keys))
     traces, done = [], 0
     while done < maxiter:
         n = min(chunk, maxiter - done)
