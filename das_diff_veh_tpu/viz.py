@@ -488,6 +488,42 @@ def plot_convergence(spreads, ax=None, fig_path: Optional[str] = None):
     return ax
 
 
+def plot_predicted_curves(model, curves, n_pred: int = 60, ax=None,
+                          fig_path: Optional[str] = None):
+    """Observed modal dispersion samples vs the inverted model's predicted
+    curves (role of the inversion notebooks' predicted-curve overlay,
+    inversion_diff_speed.ipynb cells 14-15: observed ridges + forward-model
+    curves of the best profile).
+
+    ``model``: a ``LayeredModel`` (e.g. ``InversionResult.model``);
+    ``curves``: the ``Curve`` list the inversion consumed (period-domain,
+    km/s).  Each curve's mode is forward-modelled on a dense period grid.
+    """
+    import jax.numpy as jnp
+
+    from das_diff_veh_tpu.inversion import phase_velocity
+
+    if ax is None:
+        _, ax = plt.subplots(figsize=(5, 4))
+    for i, c in enumerate(curves):
+        color = f"C{i}"
+        T = _np(c.period)
+        ax.errorbar(1.0 / T, _np(c.velocity),
+                    yerr=None if c.uncertainty is None else _np(c.uncertainty),
+                    fmt=".", ms=4, color=color, alpha=0.6,
+                    label=f"mode {c.mode} observed")
+        Tg = np.linspace(T.min(), T.max(), n_pred)
+        pred = np.asarray(phase_velocity(jnp.asarray(Tg), model, mode=c.mode))
+        ax.plot(1.0 / Tg, pred, "-", color=color,
+                label=f"mode {c.mode} predicted")
+    ax.set_xlabel("Frequency (Hz)")
+    ax.set_ylabel("Phase velocity (km/s)")
+    ax.legend(fontsize=7)
+    ax.grid(True)
+    _save(ax.figure, fig_path)
+    return ax
+
+
 def plot_sensitivity_kernels(kernels: Sequence, ax=None,
                              fig_path: Optional[str] = None):
     """Depth sensitivity kernels dc/dVs per period (role of

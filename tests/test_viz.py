@@ -123,3 +123,21 @@ def test_plot_fk_writes_file(tmp_path):
     viz.plot_fk(np.asarray(mag), np.asarray(f), np.asarray(k), fig_path=p)
     import os
     assert os.path.getsize(p) > 0
+
+
+def test_plot_predicted_curves_overlay(tmp_path):
+    import jax.numpy as jnp
+    from das_diff_veh_tpu.inversion import (Curve, LayeredModel,
+                                            density_gardner_linear,
+                                            phase_velocity, vp_from_poisson)
+    vs = jnp.asarray([0.2, 0.5])
+    vp = vp_from_poisson(vs, 0.4375)
+    m = LayeredModel(jnp.asarray([0.01, 0.0]), vp, vs,
+                     density_gardner_linear(vp))
+    T = np.linspace(0.05, 0.3, 10)
+    obs = np.asarray(phase_velocity(jnp.asarray(T), m, mode=0))
+    curves = [Curve(T, obs, 0, 1.0, 0.01 * np.ones_like(T))]
+    p = str(tmp_path / "pred.png")
+    viz.plot_predicted_curves(m, curves, fig_path=p)
+    import os
+    assert os.path.getsize(p) > 0
