@@ -210,10 +210,15 @@ def _eval_pop(misfit_fn, x, eval_chunk: int):
 @partial(jax.jit, static_argnames=("misfit_fn", "n_params", "popsize",
                                    "dtype", "eval_chunk"))
 def _pso_init(misfit_fn, key, n_params: int, popsize: int, dtype=None,
-              eval_chunk: int = 0):
+              eval_chunk: int = 0, x0=None):
     dtype = dtype or jnp.zeros(()).dtype
     k1, k2 = jax.random.split(key)
     x = jax.random.uniform(k1, (popsize, n_params), dtype=dtype)
+    if x0 is not None:
+        # warm starts: known-good points seed the population (first rows);
+        # the swarm keeps them only through pbest/gbest if they score well
+        m = min(x0.shape[0], popsize)
+        x = x.at[:m].set(jnp.clip(jnp.asarray(x0[:m], dtype), 0.0, 1.0))
     v = 0.1 * (jax.random.uniform(k2, (popsize, n_params), dtype=dtype) - 0.5)
     f = _eval_pop(misfit_fn, x, eval_chunk)
     g = jnp.argmin(f)
@@ -296,7 +301,7 @@ def invert(spec: ModelSpec, curves: Sequence[Curve], *, popsize: int = 50,
            maxiter: int = 200, n_refine_starts: int = 8,
            n_refine_steps: int = 80, n_grid: int = 400,
            n_subdiv: int = 1, dtype=None, invalid: str = "penalty",
-           seed: int = 0, misfit_fn=None) -> InversionResult:
+           seed: int = 0, misfit_fn=None, x0=None) -> InversionResult:
     """Swarm search + gradient refinement for a 1-D Vs profile.
 
     Matches the role of ``EarthModel.invert(curves, maxrun=5)`` with CPSO
@@ -313,7 +318,7 @@ def invert(spec: ModelSpec, curves: Sequence[Curve], *, popsize: int = 50,
                            maxiter=maxiter, n_refine_starts=n_refine_starts,
                            n_refine_steps=n_refine_steps, n_grid=n_grid,
                            n_subdiv=n_subdiv, dtype=dtype, invalid=invalid,
-                           seed=seed, misfit_fn=misfit_fn)
+                           seed=seed, misfit_fn=misfit_fn, x0=x0)
 
 
 def invert_multirun(spec: ModelSpec, curves: Sequence[Curve], *,
@@ -322,7 +327,7 @@ def invert_multirun(spec: ModelSpec, curves: Sequence[Curve], *,
                     n_grid: int = 400, n_subdiv: int = 1, dtype=None,
                     invalid: str = "penalty", seed: int = 0,
                     chunk: int = 50, eval_chunk: int = 0,
-                    refine_chunk: int = 0, misfit_fn=None,
+                    refine_chunk: int = 0, misfit_fn=None, x0=None,
                     mesh=None, mesh_axis: str = "win") -> InversionResult:
     """Best-of-``n_runs`` inversion with every run's swarm advanced in ONE
     batched computation (``vmap`` over the run axis).
@@ -343,6 +348,10 @@ def invert_multirun(spec: ModelSpec, curves: Sequence[Curve], *,
     — pass the SAME function object across repeated calls so the jitted
     swarm/refine executables (keyed on its identity) are traced once; the
     parity script's serial mode uses this to avoid re-tracing per restart.
+
+    ``x0``: optional ``(m, n_params)`` unit-cube warm-start points injected
+    into every run's initial population (budget-escalation reruns restart
+    from a previous best instead of from scratch).
 
     ``mesh``: optional ``jax.sharding.Mesh`` — the run axis of the swarm
     state shards over ``mesh_axis`` and each device advances its own
@@ -368,8 +377,11 @@ def invert_multirun(spec: ModelSpec, curves: Sequence[Curve], *,
         return jax.tree.map(place, tree)
 
     keys = _shard_runs(keys)
+    if x0 is not None:
+        x0 = jnp.asarray(np.asarray(x0, dtype=np.float64), dtype)
     init = partial(_pso_init, misfit_fn, n_params=spec.n_params,
-                   popsize=popsize, dtype=dtype, eval_chunk=eval_chunk)
+                   popsize=popsize, dtype=dtype, eval_chunk=eval_chunk,
+                   x0=x0)
     states = _shard_runs(jax.vmap(lambda k: init(k))(keys))
     traces, done = [], 0
     while done < maxiter:

@@ -4,7 +4,11 @@ Reproduces inversion_diff_speed.ipynb / inversion_diff_weight.ipynb cells
 5-9 on the reference's shipped bootstrap-ridge archives: per vehicle class,
 build modal curves (bands 0/2/3 -> modes 0/3/4), invert with the TPU-batched
 swarm + optax refinement, and report the evodcinv-style weighted RMSE
-(reference best: 0.2210 speed classes / 0.1164 weight classes).
+(reference best: 0.2210 speed classes / 0.1164 weight classes).  Also covers
+the second-pivot ``680_weights.npz`` archive (which no reference notebook
+ever inverts — band map established empirically, see CASES) and the joint
+two-pivot inversion of BASELINE config 5 (both pivots' curves in one
+misfit).
 
 Precision policy: the process enables x64 so float64 stays float64 (the
 round-2 version silently downcast the final rescore to f32); the *search*
@@ -56,38 +60,82 @@ from das_diff_veh_tpu.inversion.curves import Curve  # noqa: E402
 
 REF_DATA = os.environ.get("DAS_REF_DATA", "/root/reference/data")
 
-# (archive, class key, ModelSpec, band->(mode, weight) rows used)  - from
-# inversion_diff_speed.ipynb cell 5 and inversion_diff_weight.ipynb cell 5.
+# Band -> (mode, weight) rows follow inversion_diff_speed.ipynb cell 5 /
+# inversion_diff_weight.ipynb cell 5 (700 m archives: bands 0/2/3 are
+# modes 0/3/4, band 1 unused by the reference inversions).
+_700_SPEED_FAST = [("700_speeds.npz", "vels_fast", [(0, 0, 1.0), (3, 4, 1.0)])]
+_700_WEIGHT_MID = [("700_weights.npz", "vels_mid",
+                    [(0, 0, 2.0), (2, 3, 1.0), (3, 4, 1.0)])]
+# 680 m archive (data/680_weights.npz, 20 bootstrap reps, 2 bands): no
+# reference notebook consumes it, so the band->mode map is established
+# empirically — predicting overtones 1-4 from the already-inverted 700 m
+# mid-speed model puts the archive's 9-15 Hz band on MODE 1 (4.2% median
+# error vs >=16% for modes 2-4; same site, so the identification carries).
+_680 = lambda key: [("680_weights.npz", key, [(0, 0, 2.0), (1, 1, 1.0)])]
+
+# (name, ModelSpec, [(archive, class key, band rows), ...]) — multi-source
+# entries concatenate both archives' curves into ONE misfit (the joint
+# 600m+700m inversion of BASELINE config 5).
 CASES = [
-    ("700_speeds.npz", "vels_fast", "speed", [(0, 0, 1.0), (3, 4, 1.0)]),
-    ("700_speeds.npz", "vels_mid", "speed",
-     [(0, 0, 2.0), (2, 3, 1.0), (3, 4, 1.0)]),
-    ("700_speeds.npz", "vels_slow", "speed",
-     [(0, 0, 1.0), (2, 3, 1.0), (3, 4, 1.0)]),
-    ("700_weights.npz", "vels_heavy", "weight",
-     [(0, 0, 2.0), (2, 3, 1.0), (3, 4, 1.0)]),
-    ("700_weights.npz", "vels_mid", "weight",
-     [(0, 0, 2.0), (2, 3, 1.0), (3, 4, 1.0)]),
-    ("700_weights.npz", "vels_light", "weight", [(0, 0, 2.0), (3, 4, 1.0)]),
+    ("700_fast_speed", "speed", _700_SPEED_FAST),
+    ("700_mid_speed", "speed",
+     [("700_speeds.npz", "vels_mid", [(0, 0, 2.0), (2, 3, 1.0), (3, 4, 1.0)])]),
+    ("700_slow_speed", "speed",
+     [("700_speeds.npz", "vels_slow", [(0, 0, 1.0), (2, 3, 1.0), (3, 4, 1.0)])]),
+    ("700_heavy_weight", "weight",
+     [("700_weights.npz", "vels_heavy",
+       [(0, 0, 2.0), (2, 3, 1.0), (3, 4, 1.0)])]),
+    ("700_mid_weight", "weight", _700_WEIGHT_MID),
+    ("700_light_weight", "weight",
+     [("700_weights.npz", "vels_light", [(0, 0, 2.0), (3, 4, 1.0)])]),
+    ("680_heavy_weight", "weight", _680("vels_heavy")),
+    ("680_mid_weight", "weight", _680("vels_mid")),
+    ("680_light_weight", "weight", _680("vels_light")),
+    # joint two-pivot inversion: one model must explain both pivots' curve
+    # sets simultaneously (5 curves, modes 0/1/3/4)
+    ("joint_mid_weight", "weight", _700_WEIGHT_MID + _680("vels_mid")),
 ]
 
 
-def build_curves(archive: str, key: str, rows, decimate: int = 1):
-    d = load_reference_ridge_npz(os.path.join(REF_DATA, archive))
-    bands = [np.stack([np.asarray(v, dtype=np.float64) for v in d[key][i]])
-             for i in range(len(d[key]))]
-    use = [r[0] for r in rows]
-    curves = curves_from_ridges(
-        d["freqs"], d["freq_lb"], d["freq_ub"], bands,
-        band_modes=[dict((b, m) for b, m, _ in rows).get(i, 0)
-                    for i in range(len(bands))],
-        weights=[dict((b, w) for b, _, w in rows).get(i, 1.0)
-                 for i in range(len(bands))],
-        skip_bands=[i for i in range(len(bands)) if i not in use])
+def build_curves(sources, decimate: int = 1):
+    """Concatenated Curve list over one or more (archive, key, rows)."""
+    curves = []
+    for archive, key, rows in sources:
+        d = load_reference_ridge_npz(os.path.join(REF_DATA, archive))
+        bands = [np.stack([np.asarray(v, dtype=np.float64) for v in d[key][i]])
+                 for i in range(len(d[key]))]
+        use = [r[0] for r in rows]
+        curves += curves_from_ridges(
+            d["freqs"], d["freq_lb"], d["freq_ub"], bands,
+            band_modes=[dict((b, m) for b, m, _ in rows).get(i, 0)
+                        for i in range(len(bands))],
+            weights=[dict((b, w) for b, _, w in rows).get(i, 1.0)
+                     for i in range(len(bands))],
+            skip_bands=[i for i in range(len(bands)) if i not in use])
     if decimate > 1:
         curves = [Curve(c.period[::decimate], c.velocity[::decimate], c.mode,
                         c.weight, c.uncertainty[::decimate]) for c in curves]
     return curves
+
+
+def warm_points(spec, entry, rng, n_pts: int = 8):
+    """Unit-cube warm-start points from a prior result entry.
+
+    Entries carrying ``x_best`` reproduce it exactly; older entries are
+    reconstructed from ``vs_km_s``/``thickness_m`` (free-Poisson specs get
+    ``n_pts`` random nu draws since nu was not recorded; the ignored
+    halfspace-thickness coordinate stays random too)."""
+    if "x_best" in entry:
+        return np.asarray(entry["x_best"], np.float64)[None, :]
+    lo, hi = (np.asarray(a, np.float64) for a in spec.bounds_arrays())
+    n = spec.n_layers
+    pts = rng.uniform(0.05, 0.95, size=(n_pts, spec.n_params))
+    unit = lambda v, i: np.clip((v - lo[i]) / (hi[i] - lo[i]), 0.0, 1.0)
+    for i, v in enumerate(np.asarray(entry["thickness_m"], float) / 1000.0):
+        pts[:, i] = unit(v, i)
+    for i, v in enumerate(np.asarray(entry["vs_km_s"], float)):
+        pts[:, n + i] = unit(v, n + i)
+    return pts
 
 
 def rescore_f64(spec, curves, x_best, n_grid: int = 600):
@@ -144,7 +192,15 @@ def main():
                     help="start from the existing --out file and only "
                          "replace a class when the new truncated misfit is "
                          "lower (budget-escalation reruns of weak classes)")
+    ap.add_argument("--warm-start", action="store_true",
+                    help="seed each rerun class's swarm with the prior "
+                         "result (x_best if recorded, else reconstructed "
+                         "from vs/thickness); implies --merge so a weaker "
+                         "rerun can never overwrite the prior it started "
+                         "from")
     args = ap.parse_args()
+    if args.warm_start:
+        args.merge = True
 
     popsize, maxiter, ref_steps = (24, 60, 40) if args.quick else (50, 300, 150)
     popsize = args.popsize or popsize
@@ -152,7 +208,7 @@ def main():
     ref_steps = args.refine_steps or ref_steps
     run_cfg = {"popsize": popsize, "maxiter": maxiter,
                "refine_steps": ref_steps, "seed": args.seed,
-               "maxrun": args.maxrun}
+               "maxrun": args.maxrun, "warm_start": bool(args.warm_start)}
     # resume: a crashed TPU worker kills the whole jax backend for this
     # process, so recovery = rerun the script; completed cases of the SAME
     # run config are skipped (a config change invalidates the partial file)
@@ -187,17 +243,30 @@ def main():
         # backfilled per-class config is a best guess, marked as such
         for v in merged.values():
             v.setdefault("search_config", {**prior_cfg, "assumed": True})
+    # announce scope up front: the substring filter now matches across
+    # pivots (e.g. 'mid_weight' hits 700_/680_/joint_), so print exactly
+    # which classes this invocation will run before spending search budget
+    selected = [n for n, _, _ in CASES
+                if n not in results            # resumed classes won't rerun
+                and (not args.cases
+                     or any(s in n for s in args.cases.split(",")))]
+    print("cases to run:", ", ".join(selected) or "(none)", flush=True)
     t_all = time.time()
-    for archive, key, spec_name, rows in CASES:
+    for name, spec_name, sources in CASES:
         spec = speed_model_spec() if spec_name == "speed" else weight_model_spec()
-        name = f"{archive.split('_')[0]}_{key.removeprefix('vels_')}_{spec_name}"
         if name in results:
             continue
         if args.cases and not any(s in name for s in args.cases.split(",")):
             if name in merged:
                 results[name] = merged[name]
             continue
-        dec = build_curves(archive, key, rows, decimate=3)
+        dec = build_curves(sources, decimate=3)
+        x0 = None
+        if args.warm_start and name in merged:
+            x0 = warm_points(spec, merged[name],
+                             np.random.default_rng(args.seed + 1000))
+            print(f"  {name}: warm-starting from {x0.shape[0]} prior "
+                  f"point(s)", flush=True)
         t0 = time.time()
         if args.batched:
             # all maxrun restarts advance as ONE vmapped computation;
@@ -208,7 +277,7 @@ def main():
                                   n_grid=300, dtype=jnp.float32,
                                   invalid="truncate", seed=args.seed,
                                   eval_chunk=max(8, 64 // args.maxrun),
-                                  refine_chunk=8)
+                                  refine_chunk=8, x0=x0)
             print(f"  {name}: best-of-{args.maxrun} search misfit "
                   f"{float(res.misfit):.4f}", flush=True)
         else:
@@ -221,14 +290,14 @@ def main():
                 r = invert(spec, dec, popsize=popsize, maxiter=maxiter,
                            n_refine_starts=8, n_refine_steps=ref_steps,
                            n_grid=300, dtype=jnp.float32, invalid="truncate",
-                           seed=args.seed + run, misfit_fn=mf)
+                           seed=args.seed + run, misfit_fn=mf, x0=x0)
                 print(f"  {name} run {run}: misfit {float(r.misfit):.4f}",
                       flush=True)
                 if res is None or float(r.misfit) < float(res.misfit):
                     res = r
         x_best = np.asarray(res.x_best, dtype=np.float64)
         search_t = time.time() - t0
-        full = build_curves(archive, key, rows, decimate=1)
+        full = build_curves(sources, decimate=1)
         pen, trunc, n_cut = rescore_f64(spec, full, x_best)
         if (args.merge and name in merged
                 and merged[name]["misfit_truncated"] <= round(trunc, 4)):
@@ -270,8 +339,16 @@ def main():
         with open(args.out + ".partial", "w") as f:
             json.dump({**results, "config": run_cfg}, f, indent=1)
 
-    results["reference_best"] = {"speed": 0.2210, "weight": 0.1164,
-                                 "minutes_per_class": "17-20 (evodcinv CPSO)"}
+    results["reference_best"] = {
+        "speed": 0.2210, "weight": 0.1164,
+        "minutes_per_class": "17-20 (evodcinv CPSO)",
+        "note": "compare misfit_truncated (evodcinv semantics: below-cutoff "
+                "overtone samples dropped); an entry with n_below_cutoff>0 "
+                "scores on fewer samples than one with 0 — see "
+                "full_coverage_alternate where present. 680_*/joint_* have "
+                "no reference counterpart (the 680 archive is shipped but "
+                "never inverted by the reference).",
+    }
     # per-class provenance lives in each entry's search_config; this block
     # records only THIS invocation (merge reruns leave other classes as-is)
     results["config"] = {**run_cfg, "device": str(jax.devices()[0]),
