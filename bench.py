@@ -11,9 +11,11 @@ measure").
 The NumPy baseline times the FULL 60-window stack by default (no
 extrapolation; set BENCH_BASELINE_WINDOWS to reduce it — the value is then
 scaled and disclosed in the output).  A jax.profiler trace of the timed
-section is written to ``bench_profile/`` for the perf narrative, and on TPU
-backends the Pallas all-pairs kernel is benchmarked at 4096 channels
-(BASELINE config 4).
+section is written to ``bench_profile/`` for the perf narrative.  The other
+BASELINE configs are timed into ``extra``: 3-class vmapped dispersion images
+(config 2), amortized per-chunk cost + 24 h projection (config 3), and on
+TPU backends the Pallas all-pairs kernel at 4096 and 10000 channels
+(config 4; BENCH_SKIP_PALLAS / BENCH_SKIP_10K opt out).
 
 Prints ONE JSON line with the primary metric plus an ``extra`` dict:
   {"metric": "vsg_disp_700m_build", "value": <s>, "unit": "s",
@@ -86,9 +88,14 @@ def main() -> None:
     np_time = gather_time + (time.perf_counter() - t0)   # image runs once per stack
 
     # --- JAX pipeline (TPU when available) ------------------------------------
+    def gather_stage(b):
+        return V.stack_gathers(V.build_gather_batch(b, g, gcfg), b.valid)
+
+    def image_stage(s):
+        return V.gather_disp_image(s, offs, g.dt, 8.16, dcfg, -150.0, 0.0)
+
     def pipeline_body(b):
-        stack = V.stack_gathers(V.build_gather_batch(b, g, gcfg), b.valid)
-        return V.gather_disp_image(stack, offs, g.dt, 8.16, dcfg, -150.0, 0.0)
+        return image_stage(gather_stage(b))
 
     pipeline = jax.jit(pipeline_body)
 
@@ -105,32 +112,87 @@ def main() -> None:
         img = np.asarray(pipeline(batch))
     jax_time = (time.perf_counter() - t0) / reps
 
-    # device-only throughput: K pipeline executions inside ONE dispatch
-    # (inputs perturbed per iteration so XLA cannot hoist), amortizing the
-    # tunnel latency away — this is the number a non-tunneled deployment
-    # sees, and what the >=20x north star meaningfully measures.
+    # device-only throughput: K executions inside ONE dispatch (inputs
+    # perturbed per iteration so XLA cannot hoist), amortizing the tunnel
+    # latency away — this is the number a non-tunneled deployment sees, and
+    # what the >=20x north star meaningfully measures.  One protocol serves
+    # every amortized metric below.
     import dataclasses
 
     from jax import lax
 
     K = 32
 
-    @jax.jit
-    def pipeline_k(b, j0):
-        def body(i, acc):
-            b2 = dataclasses.replace(b, data=jnp.roll(b.data, i + j0, axis=0))
-            return acc + pipeline_body(b2)
-        return lax.fori_loop(0, K, body,
-                             jnp.zeros((dcfg.n_vels, dcfg.n_freqs),
-                                       jnp.float32))
+    def roll_batch(axis):
+        return lambda b, i: dataclasses.replace(
+            b, data=jnp.roll(b.data, i, axis=axis))
 
-    np.asarray(pipeline_k(batch, 0))                    # compile
-    ts = []
-    for j in range(3):
-        t0 = time.perf_counter()
-        np.asarray(pipeline_k(batch, j))
-        ts.append(time.perf_counter() - t0)
-    device_time = float(np.median(ts)) / K
+    def amortized_time(body, perturb, operand, acc_shape, k=K, reps=1):
+        """Per-execution device time of ``body`` amortized over ``k``
+        in-dispatch executions; median of ``reps`` timed dispatches."""
+        @jax.jit
+        def k_loop(op, j0):
+            return lax.fori_loop(
+                0, k, lambda i, acc: acc + body(perturb(op, i + j0)),
+                jnp.zeros(acc_shape, jnp.float32))
+
+        np.asarray(k_loop(operand, 0))                  # compile
+        ts = []
+        for j in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(k_loop(operand, j + 1))
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts)) / k
+
+    img_shape = (dcfg.n_vels, dcfg.n_freqs)
+    device_time = amortized_time(pipeline_body, roll_batch(0), batch,
+                                 img_shape, reps=3)
+
+    # stage budget of one build, measured the same amortized way (VERDICT r3
+    # weak #2: state the device-time split instead of inferring it from the
+    # profile trace alone): gather-stack stage vs dispersion-image stage
+    stack0 = jax.jit(gather_stage)(batch)   # jit: the axon rig cannot run
+    # FFT chains op-by-op (see axon environment notes)
+    stage_gather = amortized_time(gather_stage, roll_batch(0), batch,
+                                  (g.nch_out, g.wlen))
+    stage_image = amortized_time(image_stage,
+                                 lambda s, i: jnp.roll(s, i, axis=0),
+                                 stack0, img_shape)
+
+    # --- BASELINE config 2: multi-class stacked dispersion images -------------
+    # (vmap over vehicle class: 3 class batches image in ONE device program,
+    # the save_disp_imgs per-class loop of imaging_diff_*.ipynb cell 21)
+    from das_diff_veh_tpu.core.section import WindowBatch
+
+    n_cls = 3
+    per_cls = N_WINDOWS // n_cls
+    cls_batch = WindowBatch(
+        data=batch.data[:n_cls * per_cls].reshape(n_cls, per_cls,
+                                                  *batch.data.shape[1:]),
+        x=batch.x,
+        t=batch.t[:n_cls * per_cls].reshape(n_cls, per_cls, -1),
+        traj_x=batch.traj_x[:n_cls * per_cls].reshape(n_cls, per_cls, -1),
+        traj_t=batch.traj_t[:n_cls * per_cls].reshape(n_cls, per_cls, -1),
+        valid=batch.valid[:n_cls * per_cls].reshape(n_cls, per_cls))
+    cls_axes = WindowBatch(data=0, x=None, t=0, traj_x=0, traj_t=0, valid=0)
+    vpipe = jax.vmap(pipeline_body, in_axes=(cls_axes,))
+    t_cls = amortized_time(vpipe, roll_batch(1), cls_batch,
+                           (n_cls,) + img_shape, k=8) / n_cls  # per class
+
+    # --- BASELINE config 3: 24 h sliding-window time-lapse stack --------------
+    # single chip here: amortized per-chunk build cost on a typical ~4-vehicle
+    # chunk, projected to a day of 2-minute chunks.  The window axis of this
+    # same pipeline shards over a device mesh (parallel/stack.py,
+    # bit-parity-tested on the CI 8-device CPU mesh + driver dryrun), so the
+    # multi-chip number scales by the mesh size.
+    chunk_n = 4
+    chunk_batch = dataclasses.replace(
+        batch, data=batch.data[:chunk_n], t=batch.t[:chunk_n],
+        traj_x=batch.traj_x[:chunk_n], traj_t=batch.traj_t[:chunk_n],
+        valid=batch.valid[:chunk_n])
+    t_chunk = amortized_time(pipeline_body, roll_batch(0), chunk_batch,
+                             img_shape)
+    chunks_per_day = 24 * 60 // 2
 
     # primary metric per BASELINE.json: channel-pair xcorrs/sec.  Every output
     # gather row is one windowed pair correlation; both sides run when
@@ -142,6 +204,10 @@ def main() -> None:
     extra = {
         "np_baseline_s": round(np_time, 3),
         "baseline_windows_timed": n_base,
+        "vs_baseline_note": "device-only amortized time vs NumPy wall; the "
+                            "NumPy oracle has no dispatch/transfer component "
+                            "(its wall IS its compute), the device side "
+                            "excludes the tunnel round-trip disclosed below",
         "single_dispatch_s": round(jax_time, 5),
         "vs_baseline_single_dispatch": round(np_time / jax_time, 2),
         "single_dispatch_note": "includes ~100-200 ms axon tunnel round-trip "
@@ -150,6 +216,11 @@ def main() -> None:
         "xcorr_pairs_per_sec": round(n_pairs / device_time, 1),
         "xcorr_pairs_per_sec_single_dispatch": round(pairs_per_sec, 1),
         "n_pair_xcorrs": n_pairs,
+        "stage_gather_stack_s": round(stage_gather, 5),   # device-time budget
+        "stage_disp_image_s": round(stage_image, 5),      # of one build
+        "multiclass_image_amortized_s": round(t_cls, 5),      # config 2
+        "timelapse_chunk_amortized_s": round(t_chunk, 5),     # config 3
+        "timelapse_24h_equiv_s": round(t_chunk * chunks_per_day, 2),
         "profile_dir": profile_dir,
         "backend": jax.default_backend(),
     }
@@ -171,6 +242,20 @@ def main() -> None:
         dt_pallas = time.perf_counter() - t0
         extra["pallas_allpairs_4k_s"] = round(dt_pallas, 3)
         extra["pallas_allpairs_4k_pairs_per_sec"] = round(nch * nch / dt_pallas, 1)
+
+        # config 4 at its ACTUAL spec: 10k channels / 1 kHz (BASELINE.md).
+        # The streamed source-chunk path bounds memory regardless of nch.
+        if not os.environ.get("BENCH_SKIP_10K"):
+            nch10, nt10 = 10000, 4096                    # 1 kHz x ~4 s
+            big10 = jnp.asarray(
+                rng.standard_normal((nch10, nt10)).astype(np.float32))
+            jax.block_until_ready(fp(big10))             # compile
+            t0 = time.perf_counter()
+            jax.block_until_ready(fp(big10))
+            dt10 = time.perf_counter() - t0
+            extra["pallas_allpairs_10k_s"] = round(dt10, 3)
+            extra["pallas_allpairs_10k_pairs_per_sec"] = round(
+                nch10 * nch10 / dt10, 1)
 
     assert bool(jnp.isfinite(img).all()), "benchmark produced non-finite image"
     # primary = per-build device time amortized over K in-dispatch builds:

@@ -9,6 +9,7 @@ from das_diff_veh_tpu.analysis.class_profiles import (  # noqa: F401
     class_psd, class_timeseries_stats, quasi_static_signatures)
 from das_diff_veh_tpu.analysis.classed import (  # noqa: F401
     ClassedAnalysis, class_stacks, classed_analysis)
-from das_diff_veh_tpu.analysis.ridge import extract_ridge  # noqa: F401
+from das_diff_veh_tpu.analysis.ridge import (  # noqa: F401
+    extract_ridge, extract_ridge_batch)
 from das_diff_veh_tpu.analysis.bootstrap import (  # noqa: F401
     bootstrap_disp, convergence_test, sample_indices)

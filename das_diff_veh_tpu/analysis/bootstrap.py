@@ -5,11 +5,19 @@ repetition (apis/imaging_classes.py:31-36: bt_times × bt_size full gather
 builds).  Stacking is linear in the per-window gathers, so this module
 computes each window's gather ONCE and resamples *stacks* — algebraically
 identical, ~bt_times× cheaper (SURVEY.md §7 step 9) — then images and
-ridge-extracts per repetition under ``lax.map``.
+ridge-extracts per repetition.
+
+Every device stage is a module-level jitted function, so repeated calls with
+the same shapes re-use their executables.  The convergence study
+(imaging_diff_speed.ipynb cells 30-33 — the reference's single heaviest
+workload, SURVEY §3.3) exploits this by padding every repetition's index row
+to ``max_sample_num`` with a per-row count mask: all 60 ``bt_size`` sweeps
+share ONE compiled program instead of retracing per size.
 """
 
 from __future__ import annotations
 
+from functools import partial
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -17,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from das_diff_veh_tpu.config import BootstrapConfig, DispersionConfig
-from das_diff_veh_tpu.analysis.ridge import extract_ridge
+from das_diff_veh_tpu.analysis.ridge import extract_ridge_batch
 from das_diff_veh_tpu.models.vsg import gather_disp_image
 
 
@@ -36,16 +44,57 @@ def sample_indices(n_windows: int, bt_size: int, bt_times: int,
                                 replace=False) for _ in range(bt_times)])
 
 
+@jax.jit
+def _resample_stacks(gathers, idx):
+    """(bt_times, ...) mean-stacks of ``gathers[idx[r]]`` per repetition."""
+    return jax.vmap(lambda sel: jnp.mean(gathers[sel], axis=0))(idx)
+
+
+@jax.jit
+def _resample_stacks_counts(gathers, idx, counts):
+    """Masked variant: row r averages ``gathers[idx[r, :counts[r]]]``.
+
+    Index rows are padded to a common static width so every ``bt_size``
+    shares one executable; padded slots point at a valid window and are
+    masked out of the mean.
+    """
+    mask = jnp.arange(idx.shape[1])[None, :] < counts[:, None]
+
+    def one(sel, m, c):
+        g = gathers[sel]
+        return jnp.sum(g * m[(...,) + (None,) * (g.ndim - 1)], axis=0) / c
+
+    return jax.vmap(one)(idx, mask, counts)
+
+
+@partial(jax.jit, static_argnames=("offsets", "dt", "dx", "disp_cfg",
+                                   "start_x", "end_x"))
+def _image_batch(stacks, offsets, dt, dx, disp_cfg, start_x, end_x):
+    """Dispersion images of a stack batch; serial ``lax.map`` body — a
+    traced fancy-index gather of a closed-over array combined with FFTs
+    inside one map body segfaults the XLA CPU compiler, so the gather stage
+    (:func:`_resample_stacks`) stays a separate program."""
+    off = np.asarray(offsets)
+    return jax.lax.map(
+        lambda s: gather_disp_image(s, off, dt, dx, disp_cfg,
+                                    start_x, end_x),
+        stacks)
+
+
 def bootstrap_disp(gathers: jnp.ndarray, offsets: np.ndarray, dt: float,
                    dx: float, idx_matrix: np.ndarray,
                    cfg: BootstrapConfig = BootstrapConfig(),
                    disp_cfg: DispersionConfig = DispersionConfig(),
                    ref_vel: Optional[Sequence] = None,
-                   disp_start_x: float = -150.0, disp_end_x: float = 0.0):
+                   disp_start_x: float = -150.0, disp_end_x: float = 0.0,
+                   counts: Optional[np.ndarray] = None):
     """Per-mode bootstrap ridge curves.
 
     ``gathers``: (n_windows, nch_out, wlen) precomputed per-window VSGs.
     ``idx_matrix``: (bt_times, bt_size) window indices per repetition.
+    ``counts``: optional (bt_times,) — row r uses only its first
+    ``counts[r]`` indices (rows padded to a common width; see
+    :func:`convergence_test`).
     Returns ``(ridges, freqs)`` where ``ridges[mode]`` is (bt_times,
     n_freqs_in_band) and ``freqs`` is the full scan axis.
     """
@@ -56,15 +105,14 @@ def bootstrap_disp(gathers: jnp.ndarray, offsets: np.ndarray, dt: float,
     if ref_vel is None:
         ref_vel = [None] * n_modes
 
-    # two stages: the resampled stacks first (vmap gather+mean), then the
-    # imaging transform mapped over stacks — a traced fancy-index gather of a
-    # closed-over array combined with FFTs inside one lax.map body segfaults
-    # the XLA CPU compiler
-    stacks = jax.vmap(lambda sel: jnp.mean(gathers[sel], axis=0))(idx)
-    images = jax.lax.map(
-        lambda s: gather_disp_image(s, offsets, dt, dx, disp_cfg,
-                                    disp_start_x, disp_end_x),
-        stacks)                                           # (bt_times, nvel, nfreq)
+    if counts is None:
+        stacks = _resample_stacks(gathers, idx)
+    else:
+        stacks = _resample_stacks_counts(gathers, idx,
+                                         jnp.asarray(np.asarray(counts)))
+    images = _image_batch(stacks, tuple(np.asarray(offsets).tolist()),
+                          float(dt), float(dx), disp_cfg,
+                          float(disp_start_x), float(disp_end_x))
 
     ridges: List[np.ndarray] = []
     for m in range(n_modes):
@@ -73,12 +121,10 @@ def bootstrap_disp(gathers: jnp.ndarray, offsets: np.ndarray, dt: float,
         # (apis/imaging_classes.py:45)
         ref_idx = int(cfg.ref_freq_idx[m] - np.sum(freqs < cfg.freq_lb[m]))
         rv = ref_vel[m]
-        curves = [np.asarray(extract_ridge(
-            freqs[band], vels, img[:, jnp.asarray(band)],
+        ridges.append(np.asarray(extract_ridge_batch(
+            freqs[band], vels, images[:, :, band],
             ref_freq_idx=None if rv is not None else ref_idx,
-            sigma=float(cfg.sigma[m]), vel_max=cfg.vel_max, ref_vel=rv))
-            for img in images]
-        ridges.append(np.stack(curves))
+            sigma=float(cfg.sigma[m]), vel_max=cfg.vel_max, ref_vel=rv)))
     return ridges, freqs
 
 
@@ -90,13 +136,21 @@ def convergence_test(gathers: jnp.ndarray, offsets: np.ndarray, dt: float,
                      ref_vel: Optional[Sequence] = None) -> np.ndarray:
     """Bootstrap spread vs sample count (imaging_diff_speed.ipynb cell 30):
     for bt_size = 1..max, run the bootstrap and record the summed per-mode
-    ridge standard deviation.  Returns (n_modes, max_sample_num)."""
+    ridge standard deviation.  Returns (n_modes, max_sample_num).
+
+    Every index matrix is padded to ``max_sample_num`` columns with a count
+    mask, so all sweeps share the jitted stages' executables — one compile
+    for the whole study instead of one per ``bt_size``.
+    """
     n_modes = len(cfg.freq_lb)
     out = np.empty((n_modes, max_sample_num))
     for bt_size in range(1, max_sample_num + 1):
         idx = sample_indices(gathers.shape[0], bt_size, bt_times, rng)
+        pad = np.broadcast_to(idx[:, :1], (bt_times, max_sample_num - bt_size))
+        idx = np.concatenate([idx, pad], axis=1)
         ridges, _ = bootstrap_disp(gathers, offsets, dt, dx, idx, cfg,
-                                   disp_cfg, ref_vel)
+                                   disp_cfg, ref_vel,
+                                   counts=np.full(bt_times, bt_size))
         for m in range(n_modes):
             out[m, bt_size - 1] = float(np.sum(np.std(ridges[m], axis=0)))
     return out

@@ -14,10 +14,17 @@ modules/utils.py:621-678): velocity axis reversed to descending; three modes —
 All masked argmaxes use a -inf fill, which matches the reference's
 first-of-max tie behavior on the compacted subarray.  The picked curve is
 Savitzky-Golay(25,2) smoothed, as in the reference (:676).
+
+Layout: host-side preparation (axis reversal, band geometry, reference-curve
+evaluation) is split from the traced core so the bootstrap can run MANY maps
+through one jitted batched program (:func:`extract_ridge_batch`) instead of
+re-tracing per repetition — the reference's heaviest workload (SURVEY §3.3
+convergence study) hits this path 1800 times per class.
 """
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Callable, Optional
 
 import numpy as np
@@ -33,39 +40,101 @@ def _masked_argmax_vel(col: jnp.ndarray, vel: jnp.ndarray, center, sigma: float)
     return vel[jnp.argmax(score)]
 
 
+def _core(fv, vel_rev, centers, max_idx: Optional[int],
+          ref_freq_idx: Optional[int], sigma: float,
+          sg_window: int, sg_order: int):
+    """Traced ridge core on ONE already-velocity-reversed map (nvel, nfreq).
+
+    Exactly one of the three modes is active (static dispatch):
+    ``max_idx`` (plain argmax), ``centers`` (masked argmax around a
+    reference curve), or ``ref_freq_idx`` (two lax.scan walks).
+    """
+    if max_idx is not None:
+        sub_vel = vel_rev[max_idx:]
+        return sub_vel[jnp.argmax(fv[max_idx:], axis=0)]
+
+    if centers is not None:
+        picked = jax.vmap(
+            lambda col, c: _masked_argmax_vel(col, vel_rev, c, sigma),
+            in_axes=(1, 0))(fv, centers)
+    else:
+        v0 = vel_rev[jnp.argmax(fv[:, ref_freq_idx])]
+
+        def walk(cols):
+            def step(prev, col):
+                v = _masked_argmax_vel(col, vel_rev, prev, sigma)
+                return v, v
+            _, picks = jax.lax.scan(step, v0, cols)
+            return picks
+
+        back = walk(jnp.flip(fv[:, :ref_freq_idx], axis=1).T)  # ref-1 ... 0
+        fwd = walk(fv[:, ref_freq_idx + 1:].T)                 # ref+1 ...
+        picked = jnp.concatenate([jnp.flip(back), v0[None], fwd])
+    return savgol_filter(picked[None, :], sg_window, sg_order, axis=-1)[0]
+
+
+def _prep(freq: np.ndarray, vel: np.ndarray, ref_freq_idx, vel_max: float,
+          ref_vel):
+    """Host-side geometry shared by the single and batched entry points."""
+    freq = np.asarray(freq)
+    vel_rev = np.asarray(vel)[::-1].copy()
+    centers = max_idx = None
+    if ref_freq_idx is None and ref_vel is None:
+        max_idx = int(np.abs(vel_max - vel_rev).argmin())
+        ref_freq_idx = None
+    elif ref_vel is not None:
+        # accept a callable c(f) (reference interp1d curves) or a
+        # precomputed per-frequency center array
+        centers = jnp.asarray(ref_vel(freq) if callable(ref_vel)
+                              else np.asarray(ref_vel))
+        ref_freq_idx = None
+    return freq, jnp.asarray(vel_rev), centers, max_idx, ref_freq_idx
+
+
 def extract_ridge(freq: np.ndarray, vel: np.ndarray, fv_map: jnp.ndarray,
                   ref_freq_idx: Optional[int] = None, sigma: float = 25.0,
                   vel_max: float = 400.0,
                   ref_vel: Optional[Callable] = None,
                   sg_window: int = 25, sg_order: int = 2) -> jnp.ndarray:
     """Extract the ridge curve (len(freq),) from ``fv_map`` (nvel, nfreq)."""
-    freq = np.asarray(freq)
-    vel_rev = np.asarray(vel)[::-1]
-    fv = fv_map[::-1, :]                                  # match reversed vel
+    freq, vel_rev, centers, max_idx, ref_freq_idx = _prep(
+        freq, vel, ref_freq_idx, vel_max, ref_vel)
+    out = _core(fv_map[::-1, :], vel_rev, centers, max_idx,
+                None if ref_freq_idx is None else int(ref_freq_idx),
+                float(sigma), sg_window, sg_order)
+    if ref_freq_idx is not None:
+        assert out.shape[0] == freq.shape[0]
+    return out
 
-    if ref_freq_idx is None and ref_vel is None:
-        max_idx = int(np.abs(vel_max - vel_rev).argmin())
-        sub_vel = jnp.asarray(vel_rev[max_idx:].copy())
-        return sub_vel[jnp.argmax(fv[max_idx:], axis=0)]
 
-    vel_j = jnp.asarray(vel_rev.copy())
-    if ref_vel is not None:
-        centers = jnp.asarray(ref_vel(freq))
-        picked = jax.vmap(lambda col, c: _masked_argmax_vel(col, vel_j, c, sigma),
-                          in_axes=(1, 0))(fv, centers)
-    else:
-        nf = freq.shape[0]
-        v0 = vel_j[jnp.argmax(fv[:, ref_freq_idx])]
+@partial(jax.jit, static_argnames=("max_idx", "ref_freq_idx", "sigma",
+                                   "sg_window", "sg_order", "serial"))
+def _ridge_batch(fv_maps, vel_rev, centers, max_idx, ref_freq_idx,
+                 sigma, sg_window, sg_order, serial):
+    f = lambda fv: _core(fv[::-1, :], vel_rev, centers, max_idx,
+                         ref_freq_idx, sigma, sg_window, sg_order)
+    if serial:
+        return jax.lax.map(f, fv_maps)
+    return jax.vmap(f)(fv_maps)
 
-        def walk(cols):
-            def step(prev, col):
-                v = _masked_argmax_vel(col, vel_j, prev, sigma)
-                return v, v
-            _, picks = jax.lax.scan(step, v0, cols)
-            return picks
 
-        back = walk(jnp.flip(fv[:, :ref_freq_idx], axis=1).T)  # ref-1 ... 0
-        fwd = walk(fv[:, ref_freq_idx + 1:].T)                 # ref+1 ... nf-1
-        picked = jnp.concatenate([jnp.flip(back), jnp.asarray([v0]), fwd])
-        assert picked.shape[0] == nf
-    return savgol_filter(picked[None, :], sg_window, sg_order, axis=-1)[0]
+def extract_ridge_batch(freq: np.ndarray, vel: np.ndarray,
+                        fv_maps: jnp.ndarray,
+                        ref_freq_idx: Optional[int] = None,
+                        sigma: float = 25.0, vel_max: float = 400.0,
+                        ref_vel: Optional[Callable] = None,
+                        sg_window: int = 25, sg_order: int = 2,
+                        serial: Optional[bool] = None) -> jnp.ndarray:
+    """Ridges for a whole (n_maps, nvel, nfreq) batch through ONE compiled
+    program (module-level jit: repeated calls with the same shapes and
+    band settings re-use the executable — the convergence study makes 60
+    such calls).  ``serial`` maps sequentially (``lax.map``) instead of
+    vmapping; default: serial on CPU (the XLA CPU compiler struggles with
+    wide gather-heavy batches), vectorized elsewhere."""
+    if serial is None:
+        serial = jax.default_backend() == "cpu"
+    freq, vel_rev, centers, max_idx, ref_freq_idx = _prep(
+        freq, vel, ref_freq_idx, vel_max, ref_vel)
+    return _ridge_batch(fv_maps, vel_rev, centers, max_idx,
+                        None if ref_freq_idx is None else int(ref_freq_idx),
+                        float(sigma), sg_window, sg_order, bool(serial))

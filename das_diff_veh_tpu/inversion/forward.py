@@ -392,6 +392,63 @@ def phase_velocity(periods, model: LayeredModel, mode: int | jnp.ndarray = 0,
     return jnp.where(valid, c_root, jnp.nan)
 
 
+@partial(jax.jit, static_argnames=("n_grid", "refine_factor"))
+def scan_mode_diagnostics(periods, model: LayeredModel, cmin=None, cmax=None,
+                          n_grid: int = 1200, refine_factor: int = 4,
+                          rel_floor: float = 0.05):
+    """Mode-miss guard for the sign-change scan in :func:`phase_velocity`.
+
+    The root finder counts sign changes of D(c) on an ``n_grid`` scan
+    (phase_velocity above; cf. the role of disba's root bracketing).  Two
+    osculating roots inside one grid cell produce NO sign change, so every
+    overtone above them silently resolves one branch too low (round-2
+    advisory).  This diagnostic returns, per period:
+
+    - ``count``          — sign changes found at ``n_grid``;
+    - ``count_refined``  — sign changes at ``refine_factor * n_grid``
+      (calibration-free: ``missed = count_refined > count`` proves roots
+      were skipped at the working resolution);
+    - ``missed``         — the bool flag above;
+    - ``dip``            — heuristic osculation signature at the working
+      resolution alone: an interior local minimum of |D| below
+      ``rel_floor x median |D|`` with no sign change in the two adjacent
+      cells (a kissing pair whose zeros never separate, or a near-miss the
+      refined scan could still skip).
+
+    Use: run on a final model at the search's ``n_grid``; any ``missed`` or
+    ``dip`` True means that period's overtone indexing needs a finer scan
+    (the parity script records the counts next to each reported misfit).
+    """
+    periods = jnp.atleast_1d(periods)
+    wdt = jnp.result_type(periods.dtype, model.vs.dtype)
+    omega = (2.0 * jnp.pi / periods).astype(wdt)
+    vs_min = jnp.min(model.vs)
+    vs_half = model.vs[-1]
+    lo = 0.7 * vs_min if cmin is None else cmin
+    hi = 0.999 * vs_half if cmax is None else cmax
+    lo = lax.stop_gradient(jnp.asarray(lo, wdt))
+    hi = lax.stop_gradient(jnp.asarray(hi, wdt))
+
+    def scan_counts(n):
+        cs = lo + (hi - lo) * jnp.linspace(0.0, 1.0, n, dtype=wdt)
+        Ds = secular(cs[None, :], omega[:, None], model)
+        s = jnp.sign(Ds)
+        flips = (s[:, :-1] * s[:, 1:]) < 0
+        return Ds, flips, jnp.sum(flips, axis=-1)
+
+    Ds, flips, count = scan_counts(n_grid)
+    _, _, count_refined = scan_counts(refine_factor * n_grid)
+
+    absD = jnp.abs(Ds)
+    interior_min = (absD[:, 1:-1] <= absD[:, :-2]) \
+        & (absD[:, 1:-1] <= absD[:, 2:])
+    no_flip = ~(flips[:, :-1] | flips[:, 1:])             # cells around i
+    floor = rel_floor * jnp.median(absD, axis=-1, keepdims=True)
+    dip = jnp.any(interior_min & no_flip & (absD[:, 1:-1] < floor), axis=-1)
+    return {"count": count, "count_refined": count_refined,
+            "missed": count_refined > count, "dip": dip}
+
+
 def rayleigh_halfspace_velocity(vp, vs):
     """Analytic homogeneous-halfspace Rayleigh speed (oracle for tests):
     root of the classic Rayleigh polynomial in x = (c/vs)^2."""

@@ -8,7 +8,10 @@ fixed-length traces of 240-byte header + ns samples.
 
 Supports data format codes 1 (4-byte IBM float), 2 (int32), 3 (int16),
 5 (IEEE float32), 8 (int8) — format 1 and 5 cover every DAS interrogator we
-know of.
+know of.  Assumptions (loud failure otherwise): uniform ns/dt from the
+binary header (per-trace header overrides are ignored — DAS interrogators
+write uniform traces), non-zero ns and dt; a trailing partial trace is
+dropped with only the complete traces returned.
 """
 
 from __future__ import annotations
@@ -45,12 +48,19 @@ def read_segy(path: str, ch1: int = 0, ch2: int | None = None):
     """
     with open(path, "rb") as f:
         header = f.read(_TEXT_HEADER_LEN + _BIN_HEADER_LEN)
+        if len(header) < _TEXT_HEADER_LEN + _BIN_HEADER_LEN:
+            raise ValueError(f"truncated SEG-Y file (no binary header): {path}")
         binh = header[_TEXT_HEADER_LEN:]
         dt_us = int.from_bytes(binh[_BIN_DT_OFFSET:_BIN_DT_OFFSET + 2], "big", signed=False)
         ns = int.from_bytes(binh[_BIN_NS_OFFSET:_BIN_NS_OFFSET + 2], "big", signed=False)
         fmt = int.from_bytes(binh[_BIN_FORMAT_OFFSET:_BIN_FORMAT_OFFSET + 2], "big", signed=False)
         if fmt not in _SAMPLE_BYTES:
             raise ValueError(f"unsupported SEG-Y format code {fmt} in {path}")
+        if ns == 0:
+            raise ValueError(f"SEG-Y binary header declares 0 samples/trace: {path}")
+        if dt_us == 0:
+            raise ValueError(f"SEG-Y binary header declares 0 us sample interval"
+                             f" (dt unrecoverable): {path}")
         sample_bytes = _SAMPLE_BYTES[fmt]
         trace_len = _TRACE_HEADER_LEN + ns * sample_bytes
 

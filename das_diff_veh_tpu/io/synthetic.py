@@ -84,6 +84,53 @@ def _band_wavelet_spectrum(freqs: np.ndarray, fmin: float, fmax: float) -> np.nd
     return amp
 
 
+def surface_wave_field(nch: int, nt: int, dx: float, dt: float,
+                       crossing_times: np.ndarray, amps: np.ndarray,
+                       phase_velocity: Callable[[np.ndarray], np.ndarray],
+                       fmin: float = 1.0, fmax: float = 24.0,
+                       attenuation_length: float = 400.0) -> np.ndarray:
+    """(nch, nt) dispersive wavefield radiated by moving sources.
+
+    Source ``v`` fires a band-limited wavelet from every channel it crosses,
+    at ``crossing_times[v, ch]`` with amplitude ``amps[v]``; propagation
+    along the channel axis uses the prescribed c(f) (per-frequency channel
+    convolution, O(nf · nx log nx)).  Shared by the scene synthesizer and
+    the benchmark workload builder (each benchmark window radiates from its
+    own trajectory instead of re-using one cached shot)."""
+    crossing_times = np.atleast_2d(np.asarray(crossing_times, np.float64))
+    amps = np.atleast_1d(np.asarray(amps, np.float64))
+    nf = 2 * nt                                           # zero-pad to avoid wrap
+    freqs = np.fft.rfftfreq(nf, d=dt)                     # (nfr,)
+    amp = _band_wavelet_spectrum(freqs, fmin, fmax)
+    c = np.maximum(phase_velocity(freqs), 1e-3)           # (nfr,)
+
+    # propagation kernel over channel-offset d >= 0: exp(-i 2π f d / c(f)) decay
+    nxp = 2 * nch                                         # zero-pad channel conv
+    offs = np.arange(nch) * dx                            # one-sided offsets
+    geo = np.exp(-offs / attenuation_length) / np.sqrt(offs + 2.0 * dx)
+    kern = geo[None, :] * np.exp(-2j * np.pi * freqs[:, None] * offs[None, :] / c[:, None])
+    kern_pos = np.zeros((freqs.size, nxp), dtype=np.complex128)
+    kern_pos[:, :nch] = kern                              # causal (rightward) part
+    kern_neg = np.zeros_like(kern_pos)
+    kern_neg[:, 0] = kern[:, 0]
+    kern_neg[:, nxp - nch + 1:] = kern[:, 1:][:, ::-1]    # leftward part
+    # two-sided kernel; avoid double-count at zero offset
+    kern2 = kern_pos + kern_neg
+    kern2[:, 0] = kern[:, 0]
+    K = np.fft.fft(kern2, axis=-1)                        # (nfr, nxp)
+
+    sw = np.zeros((nch, nt), dtype=np.float64)
+    for v in range(crossing_times.shape[0]):
+        # source spectrum per channel crossing: delta at crossing_times[v]
+        src = np.zeros((freqs.size, nxp), dtype=np.complex128)
+        src[:, :nch] = np.exp(-2j * np.pi * freqs[:, None]
+                              * crossing_times[v][None, :])
+        U = np.fft.ifft(np.fft.fft(src, axis=-1) * K, axis=-1)[:, :nch]
+        U *= (amps[v] * amp)[:, None]
+        sw += np.fft.irfft(U.T, n=nf, axis=-1)[:, :nt]
+    return sw
+
+
 def synthesize_section(cfg: SceneConfig):
     """Build one DAS section with cfg.n_vehicles vehicles.
 
@@ -116,36 +163,10 @@ def synthesize_section(cfg: SceneConfig):
         data -= cfg.qs_amp * weight[v] * pulse
 
     # --- dispersive surface waves ---------------------------------------------
-    nf = 2 * nt                                           # zero-pad to avoid wrap
-    freqs = np.fft.rfftfreq(nf, d=dt)                     # (nfr,)
-    amp = _band_wavelet_spectrum(freqs, cfg.sw_fmin, cfg.sw_fmax)
-    c = np.maximum(cfg.phase_velocity(freqs), 1e-3)       # (nfr,)
-
-    # propagation kernel over channel-offset d >= 0: exp(-i 2π f d / c(f)) decay
-    nxp = 2 * cfg.nch                                     # zero-pad channel conv
-    offs = np.arange(cfg.nch) * cfg.dx                    # one-sided offsets
-    geo = np.exp(-offs / cfg.attenuation_length) / np.sqrt(offs + 2.0 * cfg.dx)
-    kern = geo[None, :] * np.exp(-2j * np.pi * freqs[:, None] * offs[None, :] / c[:, None])
-    kern_pos = np.zeros((freqs.size, nxp), dtype=np.complex128)
-    kern_pos[:, :cfg.nch] = kern                          # causal (rightward) part
-    kern_neg = np.zeros_like(kern_pos)
-    kern_neg[:, 0] = kern[:, 0]
-    kern_neg[:, nxp - cfg.nch + 1:] = kern[:, 1:][:, ::-1]  # leftward part
-    # two-sided kernel; avoid double-count at zero offset
-    kern2 = kern_pos + kern_neg
-    kern2[:, 0] = kern[:, 0]
-    K = np.fft.fft(kern2, axis=-1)                        # (nfr, nxp)
-
-    sw = np.zeros((cfg.nch, nt), dtype=np.float64)
-    for v in range(cfg.n_vehicles):
-        # source spectrum per channel crossing: delta at t_arr(x_s)
-        src = np.zeros((freqs.size, nxp), dtype=np.complex128)
-        src[:, :cfg.nch] = np.exp(-2j * np.pi * freqs[:, None] * t_arr[v][None, :])
-        U = np.fft.ifft(np.fft.fft(src, axis=-1) * K, axis=-1)[:, :cfg.nch]  # (nfr, nx)
-        U *= (cfg.sw_amp * weight[v] * amp)[:, None]
-        sw += np.fft.irfft(U.T, n=nf, axis=-1)[:, :nt]
-
-    data += sw
+    data += surface_wave_field(cfg.nch, nt, cfg.dx, dt, t_arr,
+                               cfg.sw_amp * weight, cfg.phase_velocity,
+                               cfg.sw_fmin, cfg.sw_fmax,
+                               cfg.attenuation_length)
     if cfg.noise_std > 0:
         data += cfg.noise_std * rng.standard_normal(data.shape)
 

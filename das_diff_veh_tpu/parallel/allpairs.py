@@ -24,8 +24,10 @@ import jax
 import jax.numpy as jnp
 try:                                    # jax >= 0.8
     from jax import shard_map
+    _NO_VMA_CHECK = {"check_vma": False}
 except ImportError:                     # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
+    _NO_VMA_CHECK = {"check_rep": False}    # same knob, pre-0.8 spelling
 from jax.sharding import Mesh, PartitionSpec as P
 
 from das_diff_veh_tpu.ops.pallas_xcorr import (_decide_pallas,
@@ -49,15 +51,21 @@ def sharded_all_pairs_peak(data: jnp.ndarray, wlen: int, mesh: Mesh, *,
     n_dev = mesh.shape[axis]
     pad = (-nch) % n_dev
     dpad = jnp.pad(data, ((0, pad), (0, 0)))
-    use_p = _decide_pallas(nch, use_pallas)
+    # decide on the PER-DEVICE workload: each shard correlates nch/n_dev
+    # source rows (not nch) against the full set, and the kernel-vs-einsum
+    # crossover tracks the smaller source-tile axis
+    use_p = _decide_pallas((nch + pad) // n_dev, use_pallas)
     # windowed spectra once, outside the shard: each device then receives its
     # source-row slice plus the replicated full set (recomputing inside the
     # shard would run the full-set rfft n_dev times)
     wf = _window_spectra(dpad, wlen, overlap_ratio)
 
+    # vma/rep checking off: the body is collective-free (each device works on
+    # its own source rows), and jax's varying-mesh-axes validation cannot see
+    # through pallas_call's out_shape (it would demand explicit vma tags)
     @partial(shard_map, mesh=mesh,
              in_specs=(P(axis, None, None), P(None, None, None)),
-             out_specs=P(axis, None))
+             out_specs=P(axis, None), **_NO_VMA_CHECK)
     def run(wf_src, wf_all):
         return peak_from_spectra(wf_src, wf_all, wlen, src_chunk, use_p,
                                  interpret)

@@ -561,7 +561,9 @@ def figure_set_from_synthetic(out_dir: str, n_windows: int = 16,
     gathers = V.build_gather_batch(batch, g, gcfg)
     stack = V.stack_gathers(gathers, batch.valid)
     offs = g.offsets(x)
-    img = V.gather_disp_image(stack, offs, g.dt, 8.16, dcfg, -150.0, 0.0)
+    dx_ch = float(x[1] - x[0])      # channel spacing from the axis itself —
+    # the one place the reference's dx=8.16 hardcode had crept back in
+    img = V.gather_disp_image(stack, offs, g.dt, dx_ch, dcfg, -150.0, 0.0)
     freqs = np.arange(dcfg.freq_min, dcfg.freq_max, dcfg.freq_step)
     vels = np.arange(dcfg.vel_min, dcfg.vel_max, dcfg.vel_step)
 

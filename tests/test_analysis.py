@@ -124,3 +124,67 @@ def test_convergence_test_shape():
                            np.random.default_rng(1), cfg, dcfg)
     assert out.shape == (1, 4)
     assert np.isfinite(out).all()
+
+
+def test_extract_ridge_batch_matches_single():
+    """The batched jitted ridge program equals per-image extract_ridge in
+    all three modes (plain argmax / reference-index walk / reference
+    curve)."""
+    from das_diff_veh_tpu.analysis import extract_ridge_batch
+
+    freqs, vels, _ = _fv_map()
+    maps = jnp.asarray(np.stack([_fv_map()[2] for _ in range(4)]))
+    for kw in (dict(vel_max=450.0),
+               dict(ref_freq_idx=30, sigma=40.0),
+               dict(ref_vel=interp1d(freqs, 500.0 - 8.0 * (freqs - 2.0)),
+                    sigma=40.0)):
+        got = np.asarray(extract_ridge_batch(freqs, vels, maps, **kw))
+        want = np.stack([np.asarray(extract_ridge(freqs, vels, maps[i], **kw))
+                         for i in range(maps.shape[0])])
+        np.testing.assert_allclose(got, want, rtol=0, atol=1e-12)
+
+
+def test_bootstrap_counts_padding_equivalence():
+    """Padded index rows + counts reproduce the unpadded bootstrap exactly:
+    the padding slots are masked out of the stack mean."""
+    nwin, nch, wlen = 8, 20, 250
+    gathers = jnp.asarray(RNG.standard_normal((nwin, nch, wlen)))
+    offsets = (np.arange(nch) - nch + 1) * 8.16
+    dcfg = DispersionConfig(freq_step=0.5, vel_step=10.0)
+    cfg = BootstrapConfig(bt_times=3, bt_size=3, sigma=(30.0,),
+                          ref_freq_idx=(10,), freq_lb=(3.0,), freq_ub=(16.0,))
+    idx = sample_indices(nwin, 3, 3, np.random.default_rng(5))
+    plain, _ = bootstrap_disp(gathers, offsets, 0.004, 8.16, idx, cfg, dcfg)
+    padded = np.concatenate(
+        [idx, np.broadcast_to(idx[:, :1], (3, 4))], axis=1)
+    masked, _ = bootstrap_disp(gathers, offsets, 0.004, 8.16, padded, cfg,
+                               dcfg, counts=np.full(3, 3))
+    np.testing.assert_allclose(masked[0], plain[0], rtol=1e-10)
+
+
+def test_convergence_study_compiles_once():
+    """VERDICT r3 item 7: the bt_size sweep must NOT retrace per size —
+    padded index rows keep every jitted stage's shapes constant, so each
+    stage gains at most one cache entry for the whole study."""
+    from das_diff_veh_tpu.analysis.bootstrap import (_image_batch,
+                                                     _resample_stacks_counts)
+    from das_diff_veh_tpu.analysis.ridge import _ridge_batch
+
+    nwin, nch, wlen = 10, 20, 250
+    gathers = jnp.asarray(RNG.standard_normal((nwin, nch, wlen)))
+    offsets = (np.arange(nch) - nch + 1) * 8.16
+    dcfg = DispersionConfig(freq_step=0.5, vel_step=10.0)
+    cfg = BootstrapConfig(bt_times=3, bt_size=3, sigma=(30.0,),
+                          ref_freq_idx=(10,), freq_lb=(3.0,), freq_ub=(16.0,))
+    before = (_resample_stacks_counts._cache_size(),
+              _image_batch._cache_size(), _ridge_batch._cache_size())
+    out = convergence_test(gathers, offsets, 0.004, 8.16, max_sample_num=5,
+                           bt_times=3, rng=np.random.default_rng(0), cfg=cfg,
+                           disp_cfg=dcfg)
+    after = (_resample_stacks_counts._cache_size(),
+             _image_batch._cache_size(), _ridge_batch._cache_size())
+    assert out.shape == (1, 5) and np.isfinite(out).all()
+    # spread shrinks with more samples (physics of the study itself)
+    assert out[0, -1] < out[0, 0]
+    grow = np.array(after) - np.array(before)
+    assert (grow <= 1).all(), f"stage retraced during bt_size sweep: {grow}"

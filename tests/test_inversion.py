@@ -277,3 +277,48 @@ def test_multirun_sharded_over_mesh_matches_unsharded():
                                np.asarray(base.misfits), rtol=1e-6)
     np.testing.assert_allclose(np.asarray(sharded.x_best),
                                np.asarray(base.x_best), atol=1e-7)
+
+
+def test_scan_mode_diagnostics_flags_osculating_pair():
+    """Round-2 advisory closure: two roots inside one grid cell are
+    detected (count-doubling + near-zero |D| dip), and the reference-band
+    working resolution n_grid=300 is demonstrably converged.
+
+    The engineered case is a low-velocity-zone model whose modes 2 and 3
+    osculate to within 2.8 m/s at 14.4 Hz (probed at n_grid=4000): a
+    100-point scan (6.1 m/s spacing) skips the pair — mode counting loses
+    exactly two sign changes and ``phase_velocity(mode=3)`` degrades to NaN
+    (requested overtone resolved past the halfspace cutoff).
+    """
+    from das_diff_veh_tpu.inversion import (LayeredModel, phase_velocity,
+                                            scan_mode_diagnostics,
+                                            vp_from_poisson,
+                                            density_gardner_linear)
+
+    vs = jnp.asarray([0.45, 0.20, 0.55, 0.75])
+    vp = vp_from_poisson(vs, 0.35)
+    lvz = LayeredModel(thickness=jnp.asarray([0.012, 0.010, 0.030, 0.05]),
+                       vp=vp, vs=vs, rho=density_gardner_linear(vp))
+    per = jnp.asarray([1.0 / 14.4])
+
+    d100 = scan_mode_diagnostics(per, lvz, n_grid=100)
+    assert bool(d100["missed"][0]) and bool(d100["dip"][0])
+    assert int(d100["count_refined"][0]) - int(d100["count"][0]) == 2
+    assert np.isnan(float(phase_velocity(per, lvz, mode=3, n_grid=100)[0]))
+
+    d300 = scan_mode_diagnostics(per, lvz, n_grid=300)
+    assert not bool(d300["missed"][0]) and not bool(d300["dip"][0])
+    c3 = float(phase_velocity(per, lvz, mode=3, n_grid=300)[0])
+    c3_fine = float(phase_velocity(per, lvz, mode=3, n_grid=4000)[0])
+    assert abs(c3 - c3_fine) < 2e-4
+
+    # the parity searches' n_grid=300 is converged for a reference-class
+    # model across the full scored band (no missed roots, no dips)
+    vs2 = jnp.asarray([0.2564, 0.3239, 0.4466, 0.3589, 0.5101, 0.8131])
+    vp2 = vp_from_poisson(vs2, 0.4375)
+    clean = LayeredModel(
+        thickness=jnp.asarray([6.0, 7.3, 5.8, 10.6, 31.3, 50.0]) / 1000.0,
+        vp=vp2, vs=vs2, rho=density_gardner_linear(vp2))
+    d = scan_mode_diagnostics(jnp.asarray(1.0 / np.arange(1.0, 25.0, 0.25)),
+                              clean, n_grid=300)
+    assert not bool(d["missed"].any()) and not bool(d["dip"].any())

@@ -86,3 +86,35 @@ def test_sharded_all_pairs_matches_single_device():
     want = np.asarray(xcorr_all_pairs_peak(data, 128, use_pallas=False))
     assert got.shape == (26, 26)
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+def test_sharded_all_pairs_pallas_interpret():
+    """ADVICE r3: the Pallas kernel path under shard_map was never
+    exercised — run it in interpret mode on the CPU mesh and require
+    equality with the unsharded einsum path."""
+    from das_diff_veh_tpu.parallel import make_mesh, sharded_all_pairs_peak
+
+    rng = np.random.default_rng(11)
+    data = jnp.asarray(rng.standard_normal((26, 256)).astype(np.float32))
+    mesh = make_mesh(8)
+    got = np.asarray(sharded_all_pairs_peak(data, 64, mesh, use_pallas=True,
+                                            interpret=True, src_chunk=4))
+    want = np.asarray(xcorr_all_pairs_peak(data, 64, use_pallas=False))
+    assert got.shape == (26, 26)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_decide_pallas_uses_per_device_rows():
+    """The sharded path's kernel-vs-einsum heuristic keys on the per-device
+    source-row count, not the global channel count."""
+    import jax
+
+    from das_diff_veh_tpu.ops.pallas_xcorr import PALLAS_MIN_CH, _decide_pallas
+
+    # single-device semantics unchanged
+    assert _decide_pallas(PALLAS_MIN_CH, None) == \
+        (jax.default_backend() not in ("cpu",))
+    assert _decide_pallas(PALLAS_MIN_CH - 1, None) is False
+    # sharded: global nch >= threshold but 8-way shards fall below it
+    nch, n_dev = PALLAS_MIN_CH, 8
+    assert _decide_pallas(nch // n_dev, None) is False
