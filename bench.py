@@ -158,6 +158,16 @@ def main() -> None:
     stage_image = amortized_time(image_stage,
                                  lambda s, i: jnp.roll(s, i, axis=0),
                                  stack0, img_shape)
+    # the alternative phase-shift transform (no padded 2-D FFT, no gather;
+    # ops/dispersion.py) on the same stack — measured, the fk/einsum path is
+    # FASTER at the reference problem size on v5e (the bilinear-sampling
+    # einsum rides the MXU; the phase-shift steering einsum is larger), so
+    # fk stays the primary path and both numbers are reported
+    dcfg_ps = dataclasses.replace(dcfg, method="phase_shift")
+    stage_image_ps = amortized_time(
+        lambda s: V.gather_disp_image(s, offs, g.dt, 8.16, dcfg_ps,
+                                      -150.0, 0.0),
+        lambda s, i: jnp.roll(s, i, axis=0), stack0, img_shape)
 
     # --- BASELINE config 2: multi-class stacked dispersion images -------------
     # (vmap over vehicle class: 3 class batches image in ONE device program,
@@ -218,6 +228,7 @@ def main() -> None:
         "n_pair_xcorrs": n_pairs,
         "stage_gather_stack_s": round(stage_gather, 5),   # device-time budget
         "stage_disp_image_s": round(stage_image, 5),      # of one build
+        "stage_disp_image_phase_shift_s": round(stage_image_ps, 5),
         "multiclass_image_amortized_s": round(t_cls, 5),      # config 2
         "timelapse_chunk_amortized_s": round(t_chunk, 5),     # config 3
         "timelapse_24h_equiv_s": round(t_chunk * chunks_per_day, 2),

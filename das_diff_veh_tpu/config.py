@@ -154,7 +154,9 @@ class DispersionConfig:
     norm: bool = False                # L1 trace norm before transform
     # "fk": reference-parity map_fv (2-D FFT + bilinear k=f/v sampling);
     # "phase_shift": frequency-domain slant stack (Park et al.), no padded
-    # 2-D FFT and no gather — the TPU-preferred path (see ops/dispersion.py).
+    # 2-D FFT and no gather (see ops/dispersion.py).  Measured on v5e at the
+    # reference problem size, "fk" is the faster of the two (bench.py
+    # stage_disp_image_* keys) as well as the parity path.
     method: str = "fk"
 
     @property

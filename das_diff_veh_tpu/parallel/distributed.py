@@ -1,0 +1,77 @@
+"""Multi-host (multi-process) cluster bootstrap over DCN.
+
+The reference's only cross-process machinery is evodcinv's ``workers=-1``
+multiprocessing pool (SURVEY.md §5); it has no distributed backend.  The
+TPU-native equivalent: each host runs one process, ``jax.distributed``
+connects them over DCN, and ``jax.devices()`` then spans every chip in the
+slice — all the mesh-sharded paths in this package (``sharded_stack_pipeline``,
+``sharded_all_pairs_peak``, ``invert_multirun(mesh=...)``) work unchanged
+because they are written against ``jax.sharding.Mesh``, not a device count.
+Collectives ride ICI within a host's chips and DCN across hosts; shardings in
+this package keep the heavy traffic (window/source-row axes) intra-host.
+
+On Cloud TPU slices ``jax.distributed.initialize()`` autodetects everything
+from the metadata server; on other clusters the coordinator triplet comes
+from the environment (the same convention torch.distributed/NCCL deployments
+use, so existing launchers port directly).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def cluster_spec_from_env(env: Optional[dict] = None):
+    """(coordinator_address, num_processes, process_id) from the environment.
+
+    Recognized variables, in precedence order:
+
+    - ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``
+      (jax's own convention);
+    - ``MASTER_ADDR``+``MASTER_PORT`` / ``WORLD_SIZE`` / ``RANK`` (the
+      torch.distributed convention most cluster launchers already export).
+
+    Returns ``(None, None, None)`` when nothing is set — callers then fall
+    through to jax's TPU-metadata autodetection.
+    """
+    e = os.environ if env is None else env
+    addr = e.get("JAX_COORDINATOR_ADDRESS")
+    if addr is None and e.get("MASTER_ADDR"):
+        addr = e["MASTER_ADDR"] + ":" + e.get("MASTER_PORT", "8476")
+    nproc = e.get("JAX_NUM_PROCESSES", e.get("WORLD_SIZE"))
+    pid = e.get("JAX_PROCESS_ID", e.get("RANK"))
+    return (addr,
+            int(nproc) if nproc is not None else None,
+            int(pid) if pid is not None else None)
+
+
+def initialize_cluster(coordinator_address: Optional[str] = None,
+                       num_processes: Optional[int] = None,
+                       process_id: Optional[int] = None) -> bool:
+    """Connect this process to the jax cluster; no-op for single-process runs.
+
+    Explicit arguments win; otherwise the environment (``cluster_spec_from_env``)
+    is consulted; with neither, on TPU pods ``jax.distributed.initialize()``
+    autodetects from platform metadata, and on a plain single host this
+    function returns ``False`` without touching jax state (so library code
+    may call it unconditionally).
+
+    Returns True when a multi-process runtime was initialized.
+    """
+    import jax
+
+    env_addr, env_n, env_pid = cluster_spec_from_env()
+    addr = coordinator_address or env_addr
+    n = num_processes if num_processes is not None else env_n
+    pid = process_id if process_id is not None else env_pid
+    if addr is None and n is None and pid is None:
+        # bare single host unless the TPU metadata server says otherwise
+        in_pod = bool(os.environ.get("TPU_WORKER_HOSTNAMES"))
+        if not in_pod:
+            return False
+        jax.distributed.initialize()
+        return True
+    jax.distributed.initialize(coordinator_address=addr,
+                               num_processes=n, process_id=pid)
+    return True

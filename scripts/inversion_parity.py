@@ -367,8 +367,12 @@ def main():
             if old_alt and old_alt.get("n_below_cutoff", 0) < n_cut:
                 cands.append(old_alt)
             if cands:
-                alternate = min(cands,
-                                key=lambda c: c.get("misfit_f64_full", 1e9))
+                # fullest coverage wins first; honest misfit breaks ties —
+                # never trade away the only zero-cutoff model for a lower
+                # misfit with more dropped samples
+                alternate = min(cands, key=lambda c: (
+                    c.get("n_below_cutoff", 10**9),
+                    c.get("misfit_f64_full", 1e9)))
         results[name] = {
             "misfit_f64_full": round(pen, 4),
             "misfit_truncated": round(trunc, 4),

@@ -52,3 +52,32 @@ def test_sharded_stack_pads_ragged_batch():
                                        disp_start_x=-60.0, disp_end_x=0.0)
     np.testing.assert_allclose(np.asarray(stack8), np.asarray(stack1),
                                rtol=1e-9, atol=1e-12)
+
+
+def test_cluster_spec_from_env_conventions():
+    """Multi-host bootstrap env parsing: jax-native and torch-style
+    conventions, with the jax spelling winning; empty env -> all None
+    (falls through to TPU-pod autodetection or single-host no-op)."""
+    from das_diff_veh_tpu.parallel import cluster_spec_from_env
+
+    assert cluster_spec_from_env({}) == (None, None, None)
+    assert cluster_spec_from_env(
+        {"MASTER_ADDR": "10.0.0.1", "MASTER_PORT": "1234",
+         "WORLD_SIZE": "4", "RANK": "2"}) == ("10.0.0.1:1234", 4, 2)
+    assert cluster_spec_from_env(
+        {"MASTER_ADDR": "10.0.0.1", "WORLD_SIZE": "4", "RANK": "0"}
+    ) == ("10.0.0.1:8476", 4, 0)
+    assert cluster_spec_from_env(
+        {"JAX_COORDINATOR_ADDRESS": "c:9", "JAX_NUM_PROCESSES": "2",
+         "JAX_PROCESS_ID": "1", "MASTER_ADDR": "ignored",
+         "WORLD_SIZE": "8", "RANK": "7"}) == ("c:9", 2, 1)
+
+
+def test_initialize_cluster_single_host_noop(monkeypatch):
+    from das_diff_veh_tpu.parallel import initialize_cluster
+
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID", "MASTER_ADDR", "WORLD_SIZE", "RANK",
+                "TPU_WORKER_HOSTNAMES"):
+        monkeypatch.delenv(var, raising=False)
+    assert initialize_cluster() is False
