@@ -72,6 +72,17 @@ def initialize_cluster(coordinator_address: Optional[str] = None,
             return False
         jax.distributed.initialize()
         return True
+    if addr is None or n is None or pid is None:
+        # partial spec (e.g. a stale MASTER_ADDR from a launcher wrapper
+        # with no WORLD_SIZE/RANK): initializing would block on a
+        # nonexistent coordinator — honor the safe-to-call-unconditionally
+        # contract by warning and staying single-process
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "incomplete cluster spec (address=%s num_processes=%s "
+            "process_id=%s); staying single-process", addr, n, pid)
+        return False
     jax.distributed.initialize(coordinator_address=addr,
                                num_processes=n, process_id=pid)
     return True
