@@ -81,3 +81,20 @@ def test_initialize_cluster_single_host_noop(monkeypatch):
                 "TPU_WORKER_HOSTNAMES"):
         monkeypatch.delenv(var, raising=False)
     assert initialize_cluster() is False
+
+
+def test_initialize_cluster_partial_spec_noop(monkeypatch, caplog):
+    """A stale MASTER_ADDR without WORLD_SIZE/RANK (partial launcher env)
+    must warn and stay single-process, not block on a dead coordinator."""
+    import logging
+
+    from das_diff_veh_tpu.parallel import initialize_cluster
+
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID", "WORLD_SIZE", "RANK",
+                "TPU_WORKER_HOSTNAMES"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("MASTER_ADDR", "10.0.0.99")
+    with caplog.at_level(logging.WARNING):
+        assert initialize_cluster() is False
+    assert any("incomplete cluster spec" in r.message for r in caplog.records)
