@@ -188,6 +188,9 @@ def main() -> None:
     vpipe = jax.vmap(pipeline_body, in_axes=(cls_axes,))
     t_cls = amortized_time(vpipe, roll_batch(1), cls_batch,
                            (n_cls,) + img_shape, k=8) / n_cls  # per class
+    # (a shared-flat-gather + masked-class-stacks formulation was measured
+    # SLOWER than this straight vmap — the nested-vmap window cuts are fine
+    # since the one-slice-stream-per-pair change)
 
     # --- BASELINE config 3: 24 h sliding-window time-lapse stack --------------
     # single chip here: amortized per-chunk build cost on a typical ~4-vehicle
