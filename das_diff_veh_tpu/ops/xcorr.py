@@ -146,13 +146,29 @@ def _masked_window_specs(data: jnp.ndarray, start, nsamp: int, wlen: int,
     w = jnp.arange(nwin)
     if backward:
         s0 = start - nsamp
-        avail = jnp.where(s0 >= 0, nsamp, 0)
+        # numpy's data[start-nsamp:start]: empty for s0 < 0, truncated at
+        # the record end for start > nt — either way window w fits iff it
+        # lies inside the real samples
+        avail = jnp.where(s0 >= 0, jnp.clip(nt - s0, 0, nsamp), 0)
     else:
         s0 = start
         avail = jnp.clip(nt - start, 0, nsamp)
     valid = (w * offset + wlen) <= avail                # (nwin,)
-    starts = jnp.clip(s0 + w * offset, 0, nt - wlen)
-    wins = cut_windows_at(data, starts, wlen)           # (..., nwin, wlen)
+    # the nwin overlapping windows tile ONE contiguous nsamp block: cut that
+    # block with a single dynamic slice (the serialized-slice loop is the
+    # pipeline's hottest op — one trip instead of nwin) and take static
+    # sub-windows.  Zero-padding the tail lets the block read past the
+    # record end; every window reaching the pad (or the clamped backward
+    # empty-slice case) has ``valid`` False by the ``avail`` bounds above,
+    # so every VALID window's samples are bit-identical to a direct cut.
+    dpad = jnp.pad(data, [(0, 0)] * (data.ndim - 1) + [(0, nsamp)])
+    block = lax.dynamic_slice_in_dim(dpad, jnp.clip(s0, 0, nt), nsamp,
+                                     axis=-1)
+    if nwin > 256:       # bounded graph for continuous-record window counts
+        wins = cut_windows_at(block, w * offset, wlen)
+    else:
+        wins = jnp.stack([block[..., k * offset:k * offset + wlen]
+                          for k in range(nwin)], axis=-2)
     return jnp.fft.rfft(wins, axis=-1), valid, jnp.sum(valid)
 
 

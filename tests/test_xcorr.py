@@ -72,3 +72,39 @@ def test_traj_follow_clips_at_boundaries():
                                           jnp.asarray([1]), jnp.asarray([3.99]),
                                           nsamp, wlen))
     assert np.isfinite(out).all()
+
+
+def test_masked_window_specs_numpy_slice_semantics_all_starts():
+    """The block-cut fast path must reproduce numpy slice semantics for
+    EVERY start — in-range, at the record end, and out of range (backward
+    start > nt truncates like data[s0:start]; s0 < 0 is the empty slice):
+    validity masks match, and every valid window's samples are exact."""
+    from das_diff_veh_tpu.ops.xcorr import _masked_window_specs
+
+    nt, nsamp, wlen, offset = 500, 300, 100, 50
+
+    def ref(data, start, backward):
+        sl = (data[max(start - nsamp, 0):start] if backward
+              else data[start:start + nsamp])
+        if backward and start - nsamp < 0:
+            sl = sl[:0]
+        nwin = (nsamp - wlen) // offset + 1
+        wins, valid = [], []
+        for w in range(nwin):
+            seg = sl[w * offset:w * offset + wlen]
+            valid.append(seg.shape[-1] == wlen)
+            wins.append(seg if valid[-1] else np.zeros(wlen))
+        return np.stack(wins), np.asarray(valid)
+
+    d = np.random.default_rng(0).standard_normal(nt)
+    for backward in (False, True):
+        for start in (0, 100, 350, 450, 499, 501, 700):
+            wf, valid, n_eff = _masked_window_specs(
+                jnp.asarray(d), jnp.asarray(start), nsamp, wlen, offset,
+                backward)
+            rw, rv = ref(d, start, backward)
+            assert np.array_equal(np.asarray(valid), rv), (backward, start)
+            assert int(n_eff) == int(rv.sum())
+            got = np.asarray(jnp.fft.irfft(wf, n=wlen, axis=-1))
+            for w in np.flatnonzero(rv):
+                np.testing.assert_allclose(got[w], rw[w], atol=1e-12)
