@@ -181,32 +181,79 @@ def xcorr_all_pairs(data: jnp.ndarray, wlen: int, overlap_ratio: float = 0.5,
     return _chunked(wf, src_chunk, finish)
 
 
+# Above this window count the kernel's (tile, nwin, fblock) VMEM operands
+# (4 inputs x 2 pipeline buffers) approach the 16 MB budget; block the
+# window-mean accumulation instead.  32 windows -> ~2 MB/operand.
+WIN_BLOCK_AUTO = 48
+
+
 def xcorr_all_pairs_peak(data: jnp.ndarray, wlen: int,
                          overlap_ratio: float = 0.5, src_chunk: int = 64,
                          use_pallas: bool | None = None,
-                         interpret: bool = False) -> jnp.ndarray:
+                         interpret: bool = False,
+                         win_block: int | None = None) -> jnp.ndarray:
     """Per-pair peak |xcorr| over all lags: (nch, nch) float32.
 
     The fully streamed form for channel counts where even a trimmed lag
     cube exceeds HBM (the 10k-channel config): per chunk, spectra tiles ->
     irfft -> lag-axis max reduction; nothing larger than
     (src_chunk, nch, wlen) ever materializes.
+
+    ``win_block`` streams the window axis too, for minutes-long records
+    (window-mean cross-spectra accumulate linearly, so the record length
+    only adds accumulation steps — per-(pair, window) throughput is
+    record-length-invariant).  Auto-enabled past ``WIN_BLOCK_AUTO`` windows
+    to keep the kernel's VMEM tiles bounded.
     """
     wf = _window_spectra(data, wlen, overlap_ratio)
     use_p = _decide_pallas(wf.shape[0], use_pallas)
-    return peak_from_spectra(wf, wf, wlen, src_chunk, use_p, interpret)
+    return peak_from_spectra(wf, wf, wlen, src_chunk, use_p, interpret,
+                             win_block=win_block)
 
 
 def peak_from_spectra(wf_src, wf_all, wlen: int, src_chunk: int,
-                      use_pallas: bool, interpret: bool = False):
+                      use_pallas: bool, interpret: bool = False,
+                      win_block: int | None = None):
     """Peak |xcorr| of every ``wf_src`` row against every ``wf_all`` row:
     (nsrc, nall) float32.  Split out so a sharded caller
     (``parallel.allpairs``) can hand each device its own source-row block
-    while the receiver side stays the full spectra set."""
+    while the receiver side stays the full spectra set.
+
+    With ``win_block`` (or automatically past ``WIN_BLOCK_AUTO`` windows)
+    the window mean is accumulated ``win_block`` windows at a time:
+    mean_w = (wb/nwin) * sum_blocks mean_block, with zero-padded windows
+    contributing nothing — so arbitrarily long records keep both the VMEM
+    tiles and the per-step working set bounded."""
+    nwin = wf_src.shape[1]
+    if win_block is None and nwin > WIN_BLOCK_AUTO:
+        win_block = 32
+
+    if not win_block or win_block >= nwin:
+        def finish(src_rows):
+            spec = _cross_spectra(src_rows, wf_all, use_pallas, interpret)
+            c = jnp.fft.irfft(spec, n=wlen, axis=-1)
+            return jnp.max(jnp.abs(c), axis=-1)
+
+        return _chunked(wf_src, src_chunk, finish)
+
+    from jax import lax
+
+    pad = (-nwin) % win_block
+    wpad = ((0, 0), (0, pad), (0, 0))
+    wf_src_p = jnp.pad(wf_src, wpad)
+    wf_all_p = jnp.pad(wf_all, wpad)
+    n_blocks = (nwin + pad) // win_block
+    nall, nf = wf_all.shape[0], wf_all.shape[2]
 
     def finish(src_rows):
-        spec = _cross_spectra(src_rows, wf_all, use_pallas, interpret)
+        def body(i, acc):
+            s = lax.dynamic_slice_in_dim(src_rows, i * win_block, win_block, 1)
+            a = lax.dynamic_slice_in_dim(wf_all_p, i * win_block, win_block, 1)
+            return acc + _cross_spectra(s, a, use_pallas, interpret)
+
+        acc0 = jnp.zeros((src_rows.shape[0], nall, nf), jnp.complex64)
+        spec = lax.fori_loop(0, n_blocks, body, acc0) * (win_block / nwin)
         c = jnp.fft.irfft(spec, n=wlen, axis=-1)
         return jnp.max(jnp.abs(c), axis=-1)
 
-    return _chunked(wf_src, src_chunk, finish)
+    return _chunked(wf_src_p, src_chunk, finish)

@@ -39,7 +39,8 @@ def sharded_all_pairs_peak(data: jnp.ndarray, wlen: int, mesh: Mesh, *,
                            axis: str = "win", overlap_ratio: float = 0.5,
                            src_chunk: int = 64,
                            use_pallas: bool | None = None,
-                           interpret: bool = False) -> jnp.ndarray:
+                           interpret: bool = False,
+                           win_block: int | None = None) -> jnp.ndarray:
     """Per-pair peak |xcorr| (nch, nch) computed with source rows sharded
     over ``mesh``'s ``axis``.  Matches ``xcorr_all_pairs_peak`` exactly
     (parity-tested on the CI 8-device CPU mesh).
@@ -68,7 +69,7 @@ def sharded_all_pairs_peak(data: jnp.ndarray, wlen: int, mesh: Mesh, *,
              out_specs=P(axis, None), **_NO_VMA_CHECK)
     def run(wf_src, wf_all):
         return peak_from_spectra(wf_src, wf_all, wlen, src_chunk, use_p,
-                                 interpret)
+                                 interpret, win_block=win_block)
 
     out = run(wf, wf)
     return out[:nch, :nch]

@@ -65,6 +65,43 @@ def test_peak_reduction_matches_full():
                                atol=1e-7)
 
 
+def test_win_block_streaming_matches_unblocked():
+    """Long-record path: accumulating window-mean cross-spectra win_block
+    windows at a time is exactly the full-window mean (linearity), incl.
+    a block count that does not divide nwin (zero-padded windows)."""
+    d = _data(nch=9, nt=1200)           # wlen 64, 50% overlap -> 36 windows
+    wlen = 64
+    want = np.asarray(xcorr_all_pairs_peak(d, wlen, use_pallas=False,
+                                           win_block=None))
+    for wb in (5, 8, 36, 100):          # ragged, even, ==nwin, >nwin
+        got = np.asarray(xcorr_all_pairs_peak(d, wlen, use_pallas=False,
+                                              win_block=wb, src_chunk=4))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+def test_win_block_pallas_interpret():
+    d = _data(nch=10, nt=900)
+    wlen = 64
+    want = np.asarray(xcorr_all_pairs_peak(d, wlen, use_pallas=False))
+    got = np.asarray(xcorr_all_pairs_peak(d, wlen, use_pallas=True,
+                                          interpret=True, win_block=8,
+                                          src_chunk=4))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_win_block_auto_engages_past_threshold():
+    """Past WIN_BLOCK_AUTO windows the blocked accumulation kicks in by
+    default and still matches an explicitly unblocked run."""
+    from das_diff_veh_tpu.ops.pallas_xcorr import WIN_BLOCK_AUTO
+
+    d = _data(nch=6, nt=(WIN_BLOCK_AUTO + 2) * 16 + 16)   # 50-51 windows
+    wlen = 32
+    auto = np.asarray(xcorr_all_pairs_peak(d, wlen, use_pallas=False))
+    explicit = np.asarray(xcorr_all_pairs_peak(d, wlen, use_pallas=False,
+                                               win_block=10 ** 6))
+    np.testing.assert_allclose(auto, explicit, rtol=2e-5, atol=1e-6)
+
+
 def test_pallas_peak_interpret():
     d = _data(nch=10, nt=256)
     wlen = 64
