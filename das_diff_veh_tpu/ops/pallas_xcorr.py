@@ -11,23 +11,43 @@ for the ~40-channel imaging gathers, hopeless for the synthetic 10k-channel
 ambient-noise config (that intermediate would be ~10 TB, and even the full
 (nch, nch, nf) spectra cube is ~800 GB).
 
-This module therefore streams at two levels:
+This module therefore streams at three levels, and every level is
+padding-free along the axes that grow with the problem:
 
 1. *Source-chunk loop* (``lax.map``): only ``src_chunk`` source rows'
-   spectra/lag products exist at a time.
-2. *Pallas kernel* inside each chunk: the (src-tile x rcv-tile x f-block)
-   grid loads two (tile, nwin, fblock) spectra tiles into VMEM, forms the
+   spectra/lag products exist at a time, so channel count never bounds
+   memory.  The receiver-side spectra are prepared (planar float32 split +
+   channel/freq tile padding) ONCE, outside the chunk loop — under
+   ``parallel.allpairs.sharded_all_pairs_peak`` that preparation happens
+   once per device, not once per chunk step.
+2. *Window-block grid dimension inside the Pallas kernel*: the window axis
+   is streamed ``win_block`` windows at a time as the kernel's innermost
+   grid dimension.  The (src-tile x rcv-tile x f-block) output tile stays
+   resident in VMEM across the window blocks while Pallas's grid pipeline
+   double-buffers the next block's spectra tiles — HBM spectra loads overlap
+   the compute of the current block, and the VMEM working set is bounded by
+   ``win_block`` regardless of record length.  A record-length ragged tail
+   (nwin not divisible by win_block) is masked *inside* the kernel; neither
+   ``wf_src`` nor ``wf_all`` is ever padded (or copied) along the window
+   axis.  Window-mean cross-spectra accumulate linearly, so per-
+   (pair, window) throughput is record-length-invariant by construction —
+   and measured so by bench.py's nt≈60k entry.
+3. *Pallas spectra-tile kernel* inside each (chunk, window-block): the grid
+   loads two (tile, win_block, fblock) spectra tiles into VMEM, forms the
    complex product and accumulates the window mean in one pass — HBM
    traffic is one read of each spectra tile per (s, r) tile pair plus one
    output-tile write; no (s, r, w, f) intermediate ever exists.
 
 Each chunk is finished in the lag domain (irfft + zero-lag roll + lag trim,
 or a per-pair peak reduction) before the next chunk starts, so arbitrarily
-large channel counts run in bounded memory.
+large channel counts AND arbitrarily long records run in bounded memory on
+both the lag-domain (``xcorr_all_pairs``) and peak (``xcorr_all_pairs_peak``)
+paths.
 
 Below ``PALLAS_MIN_CH`` channels (or on non-TPU backends) an XLA batched
 contraction ``einsum("swf,rwf->srf")`` replaces the kernel — same math,
-also 4-D-free, without explicit tiling control.
+also 4-D-free, with the same win_block-streamed accumulation (an unpadded
+``fori_loop`` over full blocks plus a static ragged-tail contraction).
 """
 
 from __future__ import annotations
@@ -36,6 +56,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -48,26 +69,68 @@ PALLAS_MIN_CH = 512     # below this the XLA einsum path wins (compile + pad ove
 _TILE_CH = 32           # (src, rcv) tile edge
 _TILE_F = 128           # frequency block (lane-aligned)
 
+# Past this window count the kernel's window axis streams in win_block-sized
+# slabs (an extra innermost grid dimension): 4 (tile, win_block, 128) f32
+# inputs x 2 pipeline buffers stay ~4 MB at the default block, independent of
+# record length.  Below it a single slab holds the whole record — the typical
+# ~7-window imaging gathers never see the streamed path.
+WIN_BLOCK_AUTO = 48
+_WIN_BLOCK_DEFAULT = 32
 
-def _spectra_tile_kernel(nwin: int, sr, si, rr, ri, cr, ci):
-    """One (src-tile, rcv-tile, f-block) step: window-mean complex product.
 
-    Block shapes: sr/si (Ts, nwin, fb), rr/ri (Tr, nwin, fb),
-    cr/ci (Ts, Tr, fb).  The w loop is static (nwin is small — ~7 for the
-    reference's 50%-overlap 2 s windows in 8 s records); each term is a VPU
-    broadcast multiply-accumulate, all operands resident in VMEM.
+def _resolve_win_block(nwin: int, win_block: int | None) -> int:
+    """Validate and normalize ``win_block`` to a slab size in [1, nwin]."""
+    if win_block is not None and win_block < 0:
+        raise ValueError(f"win_block must be None or >= 0, got {win_block}")
+    if not win_block:                   # None/0: stream only past the auto cap
+        return _WIN_BLOCK_DEFAULT if nwin > WIN_BLOCK_AUTO else max(nwin, 1)
+    return max(min(win_block, nwin), 1)
+
+
+def _spectra_tile_kernel(nwin: int, win_block: int, sr, si, rr, ri, cr, ci):
+    """One (src-tile, rcv-tile, f-block, win-block) step of the window-mean
+    complex product.
+
+    Block shapes: sr/si (Ts, win_block, fb), rr/ri (Tr, win_block, fb),
+    cr/ci (Ts, Tr, fb).  The innermost grid dimension streams the window
+    axis: the output tile is initialized at the first window block and
+    accumulated into across the rest (it stays resident in VMEM while the
+    pipeline prefetches the next block's spectra tiles — the spectra loads
+    double-buffer against this block's compute).  The per-slab w loop is
+    static; each term is a VPU broadcast multiply-accumulate, all operands
+    resident in VMEM.
+
+    When win_block does not divide nwin the last window block reads past the
+    record (Pallas pads the ragged block with unspecified values): every
+    operand of the out-of-range windows is zeroed by the ``ok`` select below,
+    so the garbage (possibly non-finite) fill never reaches the accumulator.
+    The select compiles away entirely when win_block divides nwin.
     """
+    w = pl.program_id(3)
+
+    @pl.when(w == 0)
+    def _init():
+        cr[:] = jnp.zeros(cr.shape, cr.dtype)
+        ci[:] = jnp.zeros(ci.shape, ci.dtype)
+
+    ragged = (nwin % win_block) != 0
     acc_r = jnp.zeros(cr.shape, jnp.float32)
     acc_i = jnp.zeros(ci.shape, jnp.float32)
-    for w in range(nwin):
-        a, b = sr[:, w, :], si[:, w, :]          # (Ts, fb)
-        c, d = rr[:, w, :], ri[:, w, :]          # (Tr, fb)
+    for wl in range(win_block):
+        a, b = sr[:, wl, :], si[:, wl, :]          # (Ts, fb)
+        c, d = rr[:, wl, :], ri[:, wl, :]          # (Tr, fb)
+        if ragged:
+            ok = (w * win_block + wl) < nwin
+            a = jnp.where(ok, a, 0.0)
+            b = jnp.where(ok, b, 0.0)
+            c = jnp.where(ok, c, 0.0)
+            d = jnp.where(ok, d, 0.0)
         # (a + ib)(c - id) = (ac + bd) + i(bc - ad), outer over (Ts, Tr)
         acc_r += a[:, None, :] * c[None, :, :] + b[:, None, :] * d[None, :, :]
         acc_i += b[:, None, :] * c[None, :, :] - a[:, None, :] * d[None, :, :]
     inv = jnp.float32(1.0 / nwin)
-    cr[:] = acc_r * inv
-    ci[:] = acc_i * inv
+    cr[:] += acc_r * inv
+    ci[:] += acc_i * inv
 
 
 def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
@@ -80,39 +143,105 @@ def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
     return jnp.pad(x, widths)
 
 
-@partial(jax.jit, static_argnames=("interpret",))
-def _pallas_cross_spectra(src_r, src_i, all_r, all_i,
-                          interpret: bool = False) -> jnp.ndarray:
-    """(m, nwin, nf) source-row spectra x (nch, nwin, nf) full spectra ->
-    (m, nch, nf) complex window-mean cross-spectra via the tiled kernel.
-    Pads m/nch to _TILE_CH and nf to _TILE_F; slices the padding back off."""
-    m, nwin, nf = src_r.shape
-    nch = all_r.shape[0]
-    src_r = _pad_to(_pad_to(src_r, 0, _TILE_CH), 2, _TILE_F)
-    src_i = _pad_to(_pad_to(src_i, 0, _TILE_CH), 2, _TILE_F)
-    all_r = _pad_to(_pad_to(all_r, 0, _TILE_CH), 2, _TILE_F)
-    all_i = _pad_to(_pad_to(all_i, 0, _TILE_CH), 2, _TILE_F)
-    mp, ncp, nfp = src_r.shape[0], all_r.shape[0], src_r.shape[2]
-    grid = (mp // _TILE_CH, ncp // _TILE_CH, nfp // _TILE_F)
-    src_spec = pl.BlockSpec((_TILE_CH, nwin, _TILE_F),
-                            lambda i, j, k: (i, 0, k),
+def _planar_padded(wf: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Complex (n, nwin, nf) spectra -> (real, imag) float32 planes padded to
+    the channel/freq tile grid.  The window axis is NEVER padded here — the
+    kernel's ragged-tail mask handles non-divisible window counts."""
+    r = _pad_to(_pad_to(wf.real.astype(jnp.float32), 0, _TILE_CH), 2, _TILE_F)
+    i = _pad_to(_pad_to(wf.imag.astype(jnp.float32), 0, _TILE_CH), 2, _TILE_F)
+    return r, i
+
+
+@partial(jax.jit, static_argnames=("win_block", "interpret"))
+def _pallas_cross_spectra(src_r, src_i, all_r, all_i, win_block: int,
+                          interpret: bool = False):
+    """Tile-padded planar (mp, nwin, nfp) x (ncp, nwin, nfp) spectra ->
+    (mp, ncp, nfp) float32 (real, imag) window-mean cross-spectra.
+
+    Inputs must already be channel/freq padded (``_planar_padded``); the
+    window axis is streamed through the innermost grid dimension in
+    ``win_block`` slabs with in-kernel ragged-tail masking.
+    """
+    mp, nwin, nfp = src_r.shape
+    ncp = all_r.shape[0]
+    grid = (mp // _TILE_CH, ncp // _TILE_CH, nfp // _TILE_F,
+            pl.cdiv(nwin, win_block))
+    src_spec = pl.BlockSpec((_TILE_CH, win_block, _TILE_F),
+                            lambda i, j, k, w: (i, w, k),
                             memory_space=pltpu.VMEM)
-    rcv_spec = pl.BlockSpec((_TILE_CH, nwin, _TILE_F),
-                            lambda i, j, k: (j, 0, k),
+    rcv_spec = pl.BlockSpec((_TILE_CH, win_block, _TILE_F),
+                            lambda i, j, k, w: (j, w, k),
                             memory_space=pltpu.VMEM)
     out_spec = pl.BlockSpec((_TILE_CH, _TILE_CH, _TILE_F),
-                            lambda i, j, k: (i, j, k),
+                            lambda i, j, k, w: (i, j, k),
                             memory_space=pltpu.VMEM)
     out_shape = [jax.ShapeDtypeStruct((mp, ncp, nfp), jnp.float32)] * 2
-    cr, ci = pl.pallas_call(
-        partial(_spectra_tile_kernel, nwin),
+    return pl.pallas_call(
+        partial(_spectra_tile_kernel, nwin, win_block),
         grid=grid,
         in_specs=[src_spec, src_spec, rcv_spec, rcv_spec],
         out_specs=[out_spec, out_spec],
         out_shape=out_shape,
         interpret=interpret,
     )(src_r, src_i, all_r, all_i)
-    return (cr + 1j * ci)[:m, :nch, :nf]
+
+
+def _einsum_cross_spectra(src_wf, all_wf, win_block: int):
+    """Exact-precision fallback with the same streamed window math: full
+    win_block slabs accumulate through an unpadded ``fori_loop`` and a
+    record-length ragged tail contracts as one static slice — neither
+    operand is copied or padded along the window axis."""
+    nwin = src_wf.shape[1]
+    # HIGHEST: TPUs otherwise contract this complex matmul on the MXU in
+    # bfloat16, which visibly degrades the spectra (the Pallas kernel is
+    # exact f32 VPU arithmetic; keep the fallback numerically equivalent)
+    ein = partial(jnp.einsum, "swf,rwf->srf", precision=lax.Precision.HIGHEST)
+    if win_block >= nwin:
+        return ein(src_wf, jnp.conj(all_wf)) / nwin
+    n_full = nwin // win_block
+
+    def body(i, acc):
+        s = lax.dynamic_slice_in_dim(src_wf, i * win_block, win_block, 1)
+        a = lax.dynamic_slice_in_dim(all_wf, i * win_block, win_block, 1)
+        return acc + ein(s, jnp.conj(a))
+
+    # accumulator dtype follows the inputs (complex128 under x64), not a
+    # hardcoded complex64 — a mismatched fori_loop carry would throw
+    acc0 = jnp.zeros((src_wf.shape[0], all_wf.shape[0], src_wf.shape[2]),
+                     jnp.result_type(src_wf, all_wf))
+    acc = lax.fori_loop(0, n_full, body, acc0)
+    if nwin % win_block:
+        acc = acc + ein(src_wf[:, n_full * win_block:, :],
+                        jnp.conj(all_wf[:, n_full * win_block:, :]))
+    return acc / nwin
+
+
+def _make_cross_fn(wf_all, use_pallas: bool, interpret: bool, win_block: int):
+    """Build ``cross(src_rows) -> (m, nall, nf)`` window-mean cross-spectra
+    against the fixed receiver set ``wf_all``.
+
+    The receiver-side kernel preparation (planar split + channel/freq tile
+    padding) runs HERE, once — not inside the per-chunk ``lax.map`` body —
+    so the largest array in the program is touched once per call (and once
+    per device under ``parallel.allpairs``), not once per source chunk."""
+    nall, _, nf = wf_all.shape
+    if not use_pallas:
+        return lambda src_rows: _einsum_cross_spectra(src_rows, wf_all,
+                                                      win_block)
+    all_r, all_i = _planar_padded(wf_all)
+
+    def cross(src_rows):
+        m = src_rows.shape[0]
+        src_r, src_i = _planar_padded(src_rows)
+        cr, ci = _pallas_cross_spectra(src_r, src_i, all_r, all_i,
+                                       win_block=win_block,
+                                       interpret=interpret)
+        # slice the float32 planes BEFORE forming the complex array: the
+        # padded complex intermediate was the largest per-chunk transient
+        # at 10k channels
+        return cr[:m, :nall, :nf] + 1j * ci[:m, :nall, :nf]
+
+    return cross
 
 
 def _window_spectra(data: jnp.ndarray, wlen: int,
@@ -129,20 +258,6 @@ def _decide_pallas(nch: int, use_pallas: bool | None) -> bool:
     return use_pallas
 
 
-def _cross_spectra(src_wf, all_wf, use_pallas: bool, interpret: bool):
-    """(m, nwin, nf) x (nch, nwin, nf) -> (m, nch, nf) window-mean products."""
-    if use_pallas:
-        return _pallas_cross_spectra(
-            src_wf.real.astype(jnp.float32), src_wf.imag.astype(jnp.float32),
-            all_wf.real.astype(jnp.float32), all_wf.imag.astype(jnp.float32),
-            interpret=interpret)
-    # HIGHEST: TPUs otherwise contract this complex matmul on the MXU in
-    # bfloat16, which visibly degrades the spectra (the Pallas kernel is
-    # exact f32 VPU arithmetic; keep the fallback numerically equivalent)
-    return jnp.einsum("swf,rwf->srf", src_wf, jnp.conj(all_wf),
-                      precision=jax.lax.Precision.HIGHEST) / src_wf.shape[1]
-
-
 def _chunked(wf: jnp.ndarray, src_chunk: int, finish):
     """Map ``finish(cross-spectra of chunk rows)`` over source-row chunks."""
     nch = wf.shape[0]
@@ -157,7 +272,8 @@ def _chunked(wf: jnp.ndarray, src_chunk: int, finish):
 def xcorr_all_pairs(data: jnp.ndarray, wlen: int, overlap_ratio: float = 0.5,
                     lag_keep: int | None = None, src_chunk: int = 128,
                     use_pallas: bool | None = None,
-                    interpret: bool = False) -> jnp.ndarray:
+                    interpret: bool = False,
+                    win_block: int | None = None) -> jnp.ndarray:
     """All-pairs lag-domain xcorr, zero lag centered — the (nch, nch, ...)
     generalization of ``xcorr_vshot_batch`` (parity-tested against it in
     tests/test_pallas_xcorr.py).
@@ -166,25 +282,25 @@ def xcorr_all_pairs(data: jnp.ndarray, wlen: int, overlap_ratio: float = 0.5,
     ambient-noise practice; the full 10k x 10k x wlen cube would be ~800 GB).
     Source rows are processed ``src_chunk`` at a time; each chunk's spectra
     are finished (irfft, roll, trim) before the next chunk starts.
+
+    ``win_block`` streams the window axis through the kernel grid for
+    minutes-long records (auto-enabled past ``WIN_BLOCK_AUTO`` windows), the
+    same record-length-invariant accumulation as ``xcorr_all_pairs_peak`` —
+    the lag-domain path no longer loads whole-record spectra tiles into VMEM.
     """
     wf = _window_spectra(data, wlen, overlap_ratio)
     use_p = _decide_pallas(wf.shape[0], use_pallas)
+    wb = _resolve_win_block(wf.shape[1], win_block)
+    cross = _make_cross_fn(wf, use_p, interpret, wb)
     mid = wlen // 2
     sl = slice(0, wlen) if lag_keep is None else slice(mid - lag_keep,
                                                        mid + lag_keep + 1)
 
     def finish(src_rows):
-        spec = _cross_spectra(src_rows, wf, use_p, interpret)
-        c = jnp.fft.irfft(spec, n=wlen, axis=-1)
+        c = jnp.fft.irfft(cross(src_rows), n=wlen, axis=-1)
         return jnp.roll(c, mid, axis=-1)[..., sl]
 
     return _chunked(wf, src_chunk, finish)
-
-
-# Above this window count the kernel's (tile, nwin, fblock) VMEM operands
-# (4 inputs x 2 pipeline buffers) approach the 16 MB budget; block the
-# window-mean accumulation instead.  32 windows -> ~2 MB/operand.
-WIN_BLOCK_AUTO = 48
 
 
 def xcorr_all_pairs_peak(data: jnp.ndarray, wlen: int,
@@ -202,8 +318,9 @@ def xcorr_all_pairs_peak(data: jnp.ndarray, wlen: int,
     ``win_block`` streams the window axis too, for minutes-long records
     (window-mean cross-spectra accumulate linearly, so the record length
     only adds accumulation steps — per-(pair, window) throughput is
-    record-length-invariant).  Auto-enabled past ``WIN_BLOCK_AUTO`` windows
-    to keep the kernel's VMEM tiles bounded.
+    record-length-invariant; measured by bench.py's nt≈60k entry).
+    Auto-enabled past ``WIN_BLOCK_AUTO`` windows to keep the kernel's VMEM
+    tiles bounded.
     """
     wf = _window_spectra(data, wlen, overlap_ratio)
     use_p = _decide_pallas(wf.shape[0], use_pallas)
@@ -220,40 +337,16 @@ def peak_from_spectra(wf_src, wf_all, wlen: int, src_chunk: int,
     while the receiver side stays the full spectra set.
 
     With ``win_block`` (or automatically past ``WIN_BLOCK_AUTO`` windows)
-    the window mean is accumulated ``win_block`` windows at a time:
-    mean_w = (wb/nwin) * sum_blocks mean_block, with zero-padded windows
-    contributing nothing — so arbitrarily long records keep both the VMEM
-    tiles and the per-step working set bounded."""
-    nwin = wf_src.shape[1]
-    if win_block is None and nwin > WIN_BLOCK_AUTO:
-        win_block = 32
-
-    if not win_block or win_block >= nwin:
-        def finish(src_rows):
-            spec = _cross_spectra(src_rows, wf_all, use_pallas, interpret)
-            c = jnp.fft.irfft(spec, n=wlen, axis=-1)
-            return jnp.max(jnp.abs(c), axis=-1)
-
-        return _chunked(wf_src, src_chunk, finish)
-
-    from jax import lax
-
-    pad = (-nwin) % win_block
-    wpad = ((0, 0), (0, pad), (0, 0))
-    wf_src_p = jnp.pad(wf_src, wpad)
-    wf_all_p = jnp.pad(wf_all, wpad)
-    n_blocks = (nwin + pad) // win_block
-    nall, nf = wf_all.shape[0], wf_all.shape[2]
+    the window mean accumulates ``win_block`` windows at a time inside the
+    kernel grid; a ragged tail is masked in-kernel, so ``wf_all`` — the
+    largest array of the 10k-channel config, replicated per device under
+    ``parallel.allpairs`` — is never padded or copied along the window axis.
+    Negative ``win_block`` raises ``ValueError``."""
+    wb = _resolve_win_block(wf_src.shape[1], win_block)
+    cross = _make_cross_fn(wf_all, use_pallas, interpret, wb)
 
     def finish(src_rows):
-        def body(i, acc):
-            s = lax.dynamic_slice_in_dim(src_rows, i * win_block, win_block, 1)
-            a = lax.dynamic_slice_in_dim(wf_all_p, i * win_block, win_block, 1)
-            return acc + _cross_spectra(s, a, use_pallas, interpret)
-
-        acc0 = jnp.zeros((src_rows.shape[0], nall, nf), jnp.complex64)
-        spec = lax.fori_loop(0, n_blocks, body, acc0) * (win_block / nwin)
-        c = jnp.fft.irfft(spec, n=wlen, axis=-1)
+        c = jnp.fft.irfft(cross(src_rows), n=wlen, axis=-1)
         return jnp.max(jnp.abs(c), axis=-1)
 
-    return _chunked(wf_src_p, src_chunk, finish)
+    return _chunked(wf_src, src_chunk, finish)

@@ -109,11 +109,15 @@ def xcorr_vshot_batch(data: jnp.ndarray, wlen: int, overlap_ratio: float = 0.5,
 
     Returns (nch_src, nch_rcv, wlen).  One einsum in the frequency domain;
     note it materializes the (nsrc, nrcv, nwin, nf) product, so it is for
-    imaging-sized gathers (~40 channels).  For the 10k-channel ambient-noise
-    config (BASELINE.json config 4) use ``ops.pallas_xcorr.xcorr_all_pairs``
-    / ``xcorr_all_pairs_peak`` — a source-chunked Pallas tiled kernel that
-    never materializes the pair-window product (parity-tested against this
-    function in tests/test_pallas_xcorr.py).
+    imaging-sized gathers (~40 channels) over short records.  For the
+    10k-channel ambient-noise config (BASELINE.json config 4) — or ANY
+    channel count over minutes-long records — use
+    ``ops.pallas_xcorr.xcorr_all_pairs`` / ``xcorr_all_pairs_peak``: a
+    source-chunked Pallas tiled kernel that never materializes the
+    pair-window product and streams the window axis through its grid
+    (``win_block``), so memory is bounded in both channel count and record
+    length (parity-tested against this function in
+    tests/test_pallas_xcorr.py).
     """
     offset = int(wlen * (1.0 - overlap_ratio))
     wins = sliding_windows(data, wlen, offset)          # (nch, nwin, wlen)

@@ -12,8 +12,18 @@ any downstream reduction to contract over ICI).
 
 Inside each shard the single-device streaming machinery is reused unchanged
 (``ops.pallas_xcorr``: source-chunk ``lax.map`` + Pallas spectra-tile kernel
-on TPU, exact-f32 einsum elsewhere), so per-device memory stays bounded
-regardless of channel count.
+with window-block grid streaming on TPU, exact-f32 einsum elsewhere), so
+per-device memory stays bounded regardless of channel count AND record
+length.  The receiver-side kernel preparation (planar split + tile padding
+of the replicated full spectra set — the largest array of the 10k-channel
+config) happens once per device, outside the source-chunk loop, and the
+window axis is never zero-padded or copied at all (ragged window tails are
+masked inside the kernel).
+
+``bench.py`` executes this path with ``use_pallas=True`` on the real chip
+(BENCH ``pallas_sharded_*`` entries, with parity against the unsharded
+kernel); the CI tests exercise the same code in interpret mode on the
+8-device CPU mesh.
 """
 
 from __future__ import annotations
@@ -31,6 +41,7 @@ except ImportError:                     # pragma: no cover - older jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from das_diff_veh_tpu.ops.pallas_xcorr import (_decide_pallas,
+                                               _resolve_win_block,
                                                _window_spectra,
                                                peak_from_spectra)
 
@@ -48,6 +59,7 @@ def sharded_all_pairs_peak(data: jnp.ndarray, wlen: int, mesh: Mesh, *,
     ``data``: (nch, nt) replicated; rows are zero-padded to a device-count
     multiple and the padding is trimmed from the output.
     """
+    _resolve_win_block(1, win_block)    # validate before any device work
     nch = data.shape[0]
     n_dev = mesh.shape[axis]
     pad = (-nch) % n_dev

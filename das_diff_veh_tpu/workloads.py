@@ -76,6 +76,21 @@ def make_window_batch(n_windows: int = 60, x0: float = 700.0,
     return batch, x
 
 
+def make_ambient_record(nch: int, nt: int, seed: int = 0,
+                        dtype=np.float32) -> jnp.ndarray:
+    """(nch, nt) synthetic ambient-noise record for the config-4 all-pairs
+    benchmarks (BASELINE.md: 10k channels at 1 kHz, minutes-long records).
+
+    White Gaussian noise: the all-pairs engine's cost is data-independent
+    (fixed FFT + tile-product work per (pair, window)), so an uncorrelated
+    record is throughput-representative while keeping the builder cheap
+    enough to synthesize minutes-long 10k-channel inputs (nt ~ 60k) in the
+    bench process.  A fixed ``seed`` keeps reruns byte-identical.
+    """
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal((nch, nt)).astype(dtype))
+
+
 def make_gather_geometry(x: np.ndarray, x0: float = 700.0, fs: float = 250.0,
                          cfg: GatherConfig = GatherConfig()) -> VsgGeometry:
     """Reference gather geometry for a window batch: offsets start_x .. end_x

@@ -398,12 +398,14 @@ def main():
     results["reference_best"] = {
         "speed": 0.2210, "weight": 0.1164,
         "minutes_per_class": "17-20 (evodcinv CPSO)",
-        "note": "compare misfit_truncated (evodcinv semantics: below-cutoff "
-                "overtone samples dropped); an entry with n_below_cutoff>0 "
-                "scores on fewer samples than one with 0 — see "
-                "full_coverage_alternate where present. 680_*/joint_* have "
-                "no reference counterpart (the 680 archive is shipped but "
-                "never inverted by the reference).",
+        "note": "headline metric is FULL-coverage RMSE (every sample "
+                "scored; full_coverage_alternate where it differs from the "
+                "truncated-search result, and listed FIRST in the entry). "
+                "misfit_truncated is the evodcinv-comparable secondary "
+                "(below-cutoff overtone samples dropped): an entry with "
+                "n_below_cutoff>0 scores on fewer samples than one with 0. "
+                "680_*/joint_* have no reference counterpart (the 680 "
+                "archive is shipped but never inverted by the reference).",
     }
     # per-class provenance lives in each entry's search_config; this block
     # records only THIS invocation (merge reruns leave other classes as-is)

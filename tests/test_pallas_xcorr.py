@@ -3,10 +3,14 @@ einsum path (ops/xcorr.py xcorr_vshot_batch) and internal consistency of
 the streamed variants.  The kernel itself runs in interpreter mode here
 (CPU CI); the real-TPU path is exercised by bench.py."""
 
+import jax
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
-from das_diff_veh_tpu.ops.pallas_xcorr import (xcorr_all_pairs,
+from das_diff_veh_tpu.ops.pallas_xcorr import (peak_from_spectra,
+                                               _window_spectra,
+                                               xcorr_all_pairs,
                                                xcorr_all_pairs_peak)
 from das_diff_veh_tpu.ops.xcorr import xcorr_vshot_batch
 
@@ -100,6 +104,134 @@ def test_win_block_auto_engages_past_threshold():
     explicit = np.asarray(xcorr_all_pairs_peak(d, wlen, use_pallas=False,
                                                win_block=10 ** 6))
     np.testing.assert_allclose(auto, explicit, rtol=2e-5, atol=1e-6)
+
+
+def test_lag_domain_win_block_matches_unstreamed():
+    """The lag-domain path streams the window axis too: blocked accumulation
+    (incl. a ragged tail) must reproduce the unstreamed result exactly."""
+    d = _data(nch=8, nt=1200)           # wlen 64, 50% overlap -> 36 windows
+    wlen = 64
+    want = np.asarray(xcorr_all_pairs(d, wlen, use_pallas=False))
+    for wb in (5, 8, 36, 100):          # ragged, even, ==nwin, >nwin
+        got = np.asarray(xcorr_all_pairs(d, wlen, use_pallas=False,
+                                         win_block=wb, src_chunk=4))
+        np.testing.assert_allclose(got, want, rtol=2e-5,
+                                   atol=1e-5 * np.abs(want).max())
+
+
+def test_lag_domain_win_block_pallas_interpret():
+    """Kernel-grid window streaming on the lag-domain path (ragged tail
+    masked in-kernel) vs the unstreamed einsum reference."""
+    d = _data(nch=10, nt=900)           # 27 windows: 27 % 8 = 3 ragged tail
+    wlen = 64
+    want = np.asarray(xcorr_all_pairs(d, wlen, use_pallas=False))
+    got = np.asarray(xcorr_all_pairs(d, wlen, use_pallas=True,
+                                     interpret=True, win_block=8,
+                                     src_chunk=4))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_x64_spectra_blocked_accumulator_dtype():
+    """x64-enabled callers can feed complex128 spectra straight into the
+    blocked path: the fori_loop accumulator derives its dtype from the
+    inputs (a hardcoded complex64 carry used to raise a dtype mismatch)."""
+    d = _data(nch=6, nt=640)
+    wlen = 64
+    wf = _window_spectra(d, wlen, 0.5).astype(jnp.complex128)
+    assert wf.dtype == jnp.complex128   # conftest enables x64
+    got = np.asarray(peak_from_spectra(wf, wf, wlen, 4, False, win_block=5))
+    want = np.asarray(peak_from_spectra(wf, wf, wlen, 4, False,
+                                        win_block=None))
+    np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-13)
+
+
+def test_negative_win_block_rejected():
+    d = _data(nch=6, nt=300)
+    wf = _window_spectra(d, 64, 0.5)
+    with pytest.raises(ValueError, match="win_block"):
+        peak_from_spectra(wf, wf, 64, 4, False, win_block=-1)
+    with pytest.raises(ValueError, match="win_block"):
+        xcorr_all_pairs_peak(d, 64, use_pallas=False, win_block=-3)
+    with pytest.raises(ValueError, match="win_block"):
+        xcorr_all_pairs(d, 64, use_pallas=False, win_block=-1)
+
+
+def _window_axis_pads(closed_jaxpr, nwin):
+    """Every pad equation (recursively, through scan/pjit/cond sub-jaxprs)
+    that grows axis 1 of a rank-3 spectra-shaped operand with ``nwin``
+    windows — i.e. a zero-padded window-axis copy of a spectra array."""
+    found = []
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "pad":
+                src, dst = eqn.invars[0].aval, eqn.outvars[0].aval
+                if (len(src.shape) == 3 and src.shape[1] == nwin
+                        and dst.shape[1] != nwin):
+                    found.append(eqn)
+            for p in eqn.params.values():
+                for j in (p if isinstance(p, (list, tuple)) else [p]):
+                    if isinstance(j, jax.core.ClosedJaxpr):
+                        walk(j.jaxpr)
+                    elif isinstance(j, jax.core.Jaxpr):
+                        walk(j)
+
+    walk(closed_jaxpr.jaxpr)
+    return found
+
+
+def test_no_window_axis_pad_in_blocked_paths():
+    """Acceptance: no full zero-padded copy of wf_all (or wf_src) along the
+    window axis remains in the blocked path — asserted on the traced
+    program of both the einsum and the Pallas variants."""
+    d = _data(nch=10, nt=900)           # 27 windows, win_block 8: ragged
+    wlen = 64
+    wf = _window_spectra(d, wlen, 0.5)
+    nwin = wf.shape[1]
+    assert nwin % 8 != 0                # the ragged case is the hard one
+
+    for use_pallas in (False, True):
+        jx = jax.make_jaxpr(
+            lambda ws, wa: peak_from_spectra(ws, wa, wlen, 4, use_pallas,
+                                             interpret=True, win_block=8)
+        )(wf, wf)
+        pads = _window_axis_pads(jx, nwin)
+        assert not pads, f"window-axis pad survives (pallas={use_pallas}): {pads}"
+
+
+def test_long_record_auto_streams_interpret():
+    """Interpret-mode long-record smoke test: past WIN_BLOCK_AUTO windows the
+    kernel-grid streaming engages automatically (ragged tail included) and
+    matches the unstreamed einsum reference."""
+    from das_diff_veh_tpu.ops.pallas_xcorr import (WIN_BLOCK_AUTO,
+                                                   _WIN_BLOCK_DEFAULT)
+
+    wlen = 64
+    nt = 64 * (WIN_BLOCK_AUTO + 14)     # 121 windows > auto threshold
+    d = _data(nch=6, nt=nt)
+    nwin = (nt - wlen) // (wlen // 2) + 1
+    assert nwin > WIN_BLOCK_AUTO and nwin % _WIN_BLOCK_DEFAULT != 0
+    want = np.asarray(xcorr_all_pairs_peak(d, wlen, use_pallas=False,
+                                           win_block=nwin))
+    got = np.asarray(xcorr_all_pairs_peak(d, wlen, use_pallas=True,
+                                          interpret=True, src_chunk=4))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_long_record_streamed_bench_scale():
+    """Bench-scale (nt ~ 60k) streamed sweep on the CPU einsum path — the
+    shape bench.py's BENCH long-record entry runs on-chip.  Excluded from
+    tier-1 by the ``slow`` marker; ``pytest -m slow`` runs the full sweep."""
+    rng = np.random.default_rng(17)
+    d = jnp.asarray(rng.standard_normal((48, 61440)).astype(np.float32))
+    wlen = 1024                          # 119 windows, ragged vs 32-block
+    peak = np.asarray(xcorr_all_pairs_peak(d, wlen, use_pallas=False,
+                                           src_chunk=16))
+    unstreamed = np.asarray(xcorr_all_pairs_peak(d, wlen, use_pallas=False,
+                                                 win_block=10 ** 6,
+                                                 src_chunk=16))
+    np.testing.assert_allclose(peak, unstreamed, rtol=2e-5, atol=1e-6)
 
 
 def test_pallas_peak_interpret():

@@ -54,6 +54,34 @@ def test_sharded_stack_pads_ragged_batch():
                                rtol=1e-9, atol=1e-12)
 
 
+def test_sharded_all_pairs_win_block_streams():
+    """Sharded source rows + kernel-grid window streaming compose: ragged
+    channel count over the mesh AND a ragged window tail (nwin % win_block
+    != 0) must match the unsharded, unstreamed reference."""
+    from das_diff_veh_tpu.ops.pallas_xcorr import xcorr_all_pairs_peak
+    from das_diff_veh_tpu.parallel import make_mesh, sharded_all_pairs_peak
+
+    rng = np.random.default_rng(7)
+    data = jnp.asarray(rng.standard_normal((26, 1504)).astype(np.float32))
+    mesh = make_mesh(8)
+    # wlen 64, 50% overlap -> 46 windows; 46 % 8 = 6 ragged tail
+    got = np.asarray(sharded_all_pairs_peak(data, 64, mesh, use_pallas=False,
+                                            win_block=8, src_chunk=4))
+    want = np.asarray(xcorr_all_pairs_peak(data, 64, use_pallas=False))
+    assert got.shape == (26, 26)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+def test_sharded_all_pairs_negative_win_block_rejected():
+    import pytest
+
+    from das_diff_veh_tpu.parallel import make_mesh, sharded_all_pairs_peak
+
+    data = jnp.zeros((8, 256), jnp.float32)
+    with pytest.raises(ValueError, match="win_block"):
+        sharded_all_pairs_peak(data, 64, make_mesh(8), win_block=-2)
+
+
 def test_cluster_spec_from_env_conventions():
     """Multi-host bootstrap env parsing: jax-native and torch-style
     conventions, with the jax spelling winning; empty env -> all None
