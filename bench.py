@@ -19,7 +19,10 @@ TPU backends the Pallas all-pairs engine (config 4): unsharded 4096- and
 10000-channel runs, the shard_map'd Pallas path on the device mesh with
 parity vs the unsharded kernel, and a minutes-long (nt = 61440) record
 through the win_block-streamed kernel with its record-length-invariance
-ratio.  Opt-outs: BENCH_SKIP_PALLAS / BENCH_SKIP_SHARDED / BENCH_SKIP_LONG /
+ratio.  An end-to-end batch-runtime entry measures chunks/s of the serial loop vs
+the prefetching executor on a synthetic compressed-npz directory
+(``e2e_*`` keys; BENCH_E2E_FILES/REPS/DEPTH tune it).  Opt-outs:
+BENCH_SKIP_E2E / BENCH_SKIP_PALLAS / BENCH_SKIP_SHARDED / BENCH_SKIP_LONG /
 BENCH_SKIP_10K; BENCH_10K_SRC_CHUNK tunes the 10k source-chunk size
 (default 32 — see docs/PERF.md on the working-set effect).
 
@@ -260,6 +263,67 @@ def main() -> None:
         "profile_dir": profile_dir,
         "backend": jax.default_backend(),
     }
+
+    # --- end-to-end batch runtime: serial vs prefetching chunks/s -------------
+    # The pipelined execution runtime (das_diff_veh_tpu.runtime) overlaps
+    # host npz read + savgol preprocess + H2D staging with device compute.
+    # Measured on a synthetic per-date directory written fresh each run
+    # (compressed npz — decompression is the realistic host I/O cost), serial
+    # (prefetch_depth=0) vs prefetching, median of BENCH_E2E_REPS runs each.
+    if not os.environ.get("BENCH_SKIP_E2E"):
+        import shutil
+        import tempfile
+
+        from das_diff_veh_tpu.config import ImagingConfig, PipelineConfig
+        from das_diff_veh_tpu.io.readers import DirectoryDataset
+        from das_diff_veh_tpu.io.synthetic import SceneConfig, synthesize_section
+        from das_diff_veh_tpu.pipeline.workflow import run_directory
+        from das_diff_veh_tpu.runtime import RuntimeConfig
+
+        n_files = int(os.environ.get("BENCH_E2E_FILES", 8))
+        e2e_reps = max(1, int(os.environ.get("BENCH_E2E_REPS", 3)))
+        e2e_depth = int(os.environ.get("BENCH_E2E_DEPTH", 3))
+        e2e_dur = float(os.environ.get("BENCH_E2E_DURATION", 240.0))
+        scene, _ = synthesize_section(SceneConfig(
+            nch=100, duration=e2e_dur, n_vehicles=6, seed=7,
+            speed_range=(12.0, 18.0)))
+        pcfg = PipelineConfig().replace(imaging=ImagingConfig(x0=400.0))
+        tdir = tempfile.mkdtemp(prefix="e2e_bench_")
+        try:
+            day = os.path.join(tdir, "20230301")
+            os.makedirs(day)
+            sdata = np.asarray(scene.data)
+            for i in range(n_files):
+                np.savez_compressed(
+                    os.path.join(day, f"20230301_{i:02d}0000.npz"),
+                    data=sdata * (1.0 + 0.01 * i), x_axis=np.asarray(scene.x),
+                    t_axis=np.asarray(scene.t))
+
+            def e2e_run(depth: int) -> float:
+                ds = DirectoryDataset("20230301", root=tdir, ch1=None,
+                                      ch2=None, smoothing=True,
+                                      rescale_after=None)
+                t0 = time.perf_counter()
+                res = run_directory(ds, pcfg, method="xcorr",
+                                    x_is_channels=False,
+                                    runtime=RuntimeConfig(prefetch_depth=depth,
+                                                          max_retries=0))
+                dt = time.perf_counter() - t0
+                assert res.n_chunks > 0 and not res.quarantined
+                return n_files / dt
+
+            e2e_run(0)                                   # compile warm-up
+            serial = float(np.median([e2e_run(0) for _ in range(e2e_reps)]))
+            prefetch = float(np.median([e2e_run(e2e_depth)
+                                        for _ in range(e2e_reps)]))
+            extra["e2e_files"] = n_files
+            extra["e2e_reps"] = e2e_reps
+            extra["e2e_prefetch_depth"] = e2e_depth
+            extra["e2e_serial_chunks_per_s"] = round(serial, 4)
+            extra["e2e_prefetch_chunks_per_s"] = round(prefetch, 4)
+            extra["e2e_prefetch_speedup"] = round(prefetch / serial, 3)
+        finally:
+            shutil.rmtree(tdir, ignore_errors=True)
 
     # --- Pallas all-pairs kernel (BASELINE config 4) --------------------------
     # TPU backends only (the kernel uses pltpu memory spaces); "axon" is the

@@ -143,9 +143,17 @@ class DirectoryDataset:
     def __len__(self) -> int:
         return len(self.files)
 
-    def __getitem__(self, idx: int) -> DasSection:
+    def read(self, idx: int) -> DasSection:
+        """Raw host I/O stage: npz load + channel cut + taper cut.
+
+        Split from :meth:`preprocess` so the batch runtime can trace (and
+        overlap) the two host stages separately.
+        """
+        return read_npz_section(self.files[idx], ch1=self.ch1, ch2=self.ch2)
+
+    def preprocess(self, sec: DasSection, idx: int) -> DasSection:
+        """Host preprocessing stage: savgol pre-smooth + date rescale."""
         path = self.files[idx]
-        sec = read_npz_section(path, ch1=self.ch1, ch2=self.ch2)
         data = np.asarray(sec.data)
         if self.smoothing:
             from scipy.signal import savgol_filter
@@ -155,6 +163,9 @@ class DirectoryDataset:
             if date > self.rescale_after:
                 data = data / self.rescale_value
         return DasSection(data, sec.x, sec.t)
+
+    def __getitem__(self, idx: int) -> DasSection:
+        return self.preprocess(self.read(idx), idx)
 
     def __iter__(self) -> Iterator[DasSection]:
         for i in range(len(self)):

@@ -19,7 +19,7 @@ is O(nf · nx log nx) instead of O(nf · nx²).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Callable, Optional
+from typing import Callable
 
 import numpy as np
 

@@ -8,7 +8,6 @@ hann window, 50% overlap, constant detrend, density scaling.
 from __future__ import annotations
 
 import jax.numpy as jnp
-import numpy as np
 
 
 def _hann_periodic(n: int, dtype) -> jnp.ndarray:

@@ -30,7 +30,6 @@ from __future__ import annotations
 
 from functools import partial
 
-import jax
 import jax.numpy as jnp
 try:                                    # jax >= 0.8
     from jax import shard_map

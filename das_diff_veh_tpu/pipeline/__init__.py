@@ -3,7 +3,9 @@ workflows with checkpoint/resume, and the CLI.
 
 Replaces the reference's eager compute-in-constructor orchestration
 (apis/timeLapseImaging.py, apis/imaging_workflow.py) with explicit staged
-pure functions around jit boundaries.
+pure functions around jit boundaries.  The batch workflows execute on the
+pipelined runtime (``das_diff_veh_tpu.runtime``): prefetch, per-chunk fault
+isolation, manifest-driven exact resume, and Chrome-trace span output.
 """
 
 from das_diff_veh_tpu.pipeline.preprocess import (  # noqa: F401

@@ -1,16 +1,19 @@
 """Command-line batch imaging (reference apis/imaging_workflow.py:206-223).
 
     python -m das_diff_veh_tpu.pipeline.cli --data_root /data \
-        --start_date 20230301 --end_date 20230307 --x0 700 --method xcorr
+        --start_date 20230301 --end_date 20230307 --x0 700 --method xcorr \
+        --prefetch_depth 3 --trace results/run_trace.jsonl
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 
 from das_diff_veh_tpu.config import ImagingConfig, PipelineConfig
 from das_diff_veh_tpu.pipeline.workflow import run_date_range
+from das_diff_veh_tpu.runtime import RuntimeConfig
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -23,15 +26,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--x0", type=float, default=700.0, help="pivot along fiber [m]")
     p.add_argument("--n_min_save", type=float, default=60.0,
                    help="checkpoint the running average every N data-minutes")
+    p.add_argument("--max_chunks", type=int, default=None,
+                   help="process at most N remaining chunks per date "
+                        "(smoke runs; the manifest resumes the rest later)")
     p.add_argument("--verbal", action="store_true", help="per-chunk progress logs")
     p.add_argument("--figures", action="store_true",
                    help="write the reference QC figure set from a synthetic "
                         "run into out_dir and exit (no data_root needed)")
+    rt = p.add_argument_group("runtime", "pipelined batch-execution knobs")
+    rt.add_argument("--prefetch_depth", type=int, default=2,
+                    help="chunks staged ahead by the loader thread; 0 = serial")
+    rt.add_argument("--retries", type=int, default=1,
+                    help="retry attempts per chunk stage before quarantine")
+    rt.add_argument("--retry_backoff", type=float, default=0.05,
+                    help="linear backoff unit between retries [s]")
+    rt.add_argument("--trace", default=None, metavar="PATH",
+                    help="write Chrome-trace JSONL spans to PATH "
+                         "(open in chrome://tracing or Perfetto)")
     return p
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO if args.verbal else logging.WARNING,
                         format="%(asctime)s %(name)s %(message)s")
     if args.figures:
@@ -40,13 +57,18 @@ def main(argv=None) -> int:
             print(f)
         return 0
     if not (args.data_root and args.start_date and args.end_date):
-        build_parser().error("--data_root/--start_date/--end_date are "
-                             "required unless --figures is given")
+        parser.error("--data_root/--start_date/--end_date are "
+                     "required unless --figures is given")
     cfg = PipelineConfig().replace(imaging=ImagingConfig(x0=args.x0))
+    runtime = RuntimeConfig(prefetch_depth=args.prefetch_depth,
+                            max_retries=args.retries,
+                            retry_backoff_s=args.retry_backoff,
+                            trace_path=args.trace)
     summary = run_date_range(args.data_root, args.start_date, args.end_date,
                              cfg=cfg, method=args.method, out_dir=args.out_dir,
-                             n_min_save=args.n_min_save)
-    print(summary)
+                             n_min_save=args.n_min_save,
+                             max_chunks=args.max_chunks, runtime=runtime)
+    print(json.dumps(summary, indent=1))
     return 0
 
 

@@ -19,8 +19,7 @@ from das_diff_veh_tpu.config import PipelineConfig
 from das_diff_veh_tpu.core.section import DasSection, VehicleTracks, WindowBatch
 from das_diff_veh_tpu.models import vsg as V
 from das_diff_veh_tpu.models.tracking import track_section
-from das_diff_veh_tpu.models.windows import (mute_along_time, select_windows,
-                                             traj_mute_mask)
+from das_diff_veh_tpu.models.windows import select_windows, traj_mute_mask
 from das_diff_veh_tpu.pipeline.preprocess import (channels_to_distance,
                                                   preprocess_for_surface_waves,
                                                   preprocess_for_tracking)
@@ -80,7 +79,7 @@ def disp_image_batch(batch: WindowBatch, cfg: PipelineConfig) -> jnp.ndarray:
     return jax.lax.map(one, args)
 
 
-def process_chunk(section: DasSection, cfg: PipelineConfig = PipelineConfig(),
+def process_chunk(section: DasSection, cfg: Optional[PipelineConfig] = None,
                   method: str = "xcorr", x_is_channels: bool = False,
                   with_qs: bool = False) -> ChunkResult:
     """Full per-chunk pipeline (reference TimeLapseImaging usage in
@@ -94,6 +93,7 @@ def process_chunk(section: DasSection, cfg: PipelineConfig = PipelineConfig(),
     because the imaging workflow never consumes them.
     """
     assert method in {"xcorr", "surface_wave"}
+    cfg = cfg if cfg is not None else PipelineConfig()
     x_dist = (channels_to_distance(section.x, cfg.interrogator)
               if x_is_channels else np.asarray(section.x))
     t = np.asarray(section.t)

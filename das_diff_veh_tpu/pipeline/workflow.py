@@ -1,10 +1,19 @@
-"""Batch workflows: per-directory chunk loops and date-range batches, with
-artifact checkpointing and skip-if-exists resume.
+"""Batch workflows as thin callers of the pipelined execution runtime.
 
 Reference counterparts: ImagingWorkflowOneDirectory.imaging
 (apis/imaging_workflow.py:23-111 — running average, per-window wall-time
 print, periodic intermediate snapshots) and Imaging_for_multiple_date_range
 (:132-203 — date folder loop, resume by output existence).
+
+The serial reference loop (read -> preprocess -> compute -> accumulate, one
+chunk at a time, skip-date-if-output-exists resume) is replaced by
+:mod:`das_diff_veh_tpu.runtime`: a background loader prefetches and stages
+the next chunks while the device computes the current one, per-chunk
+failures are retried then quarantined instead of aborting the date, resume
+is exact (config-hash-keyed manifest + partial-accumulator state, restart
+mid-date), and every stage emits Chrome-trace spans.  Accumulation stays on
+the main thread in sorted-file order, so results are bit-identical to the
+serial loop at any prefetch depth.
 """
 
 from __future__ import annotations
@@ -17,12 +26,17 @@ from dataclasses import dataclass, field
 from datetime import datetime, timedelta
 from typing import List, Optional
 
+from zipfile import BadZipFile as zipfile_BadZipFile
+
 import numpy as np
 import jax
 
 from das_diff_veh_tpu.config import PipelineConfig
+from das_diff_veh_tpu.core.section import DasSection
 from das_diff_veh_tpu.io.readers import DirectoryDataset
 from das_diff_veh_tpu.pipeline.timelapse import process_chunk
+from das_diff_veh_tpu.runtime import (ChunkTask, RunManifest, RuntimeConfig,
+                                      config_hash, make_tracer, run_pipelined)
 
 log = logging.getLogger("das_diff_veh_tpu.workflow")
 
@@ -43,50 +57,254 @@ def date_range(start_date: str, end_date: str, fmt: str = "%Y%m%d") -> List[str]
 class DirectoryResult:
     avg_image: Optional[np.ndarray] = None   # sum of per-chunk averages (nvel, nfreq)
     n_vehicles: int = 0                      # isolated vehicles accumulated
-    n_chunks: int = 0
+    n_chunks: int = 0                        # chunks that contributed windows
     wall_s: float = 0.0
     checkpoints: list = field(default_factory=list)
+    quarantined: list = field(default_factory=list)  # QuarantineRecord per bad chunk
+    n_retries: int = 0
+    n_resumed: int = 0                       # chunks restored from the manifest
+    chunks_per_s: float = 0.0                # processed this run (excl. resumed)
+    vehicles_per_s: float = 0.0
+    complete: bool = True                    # every file settled (not truncated)
 
 
-def run_directory(dataset: DirectoryDataset, cfg: PipelineConfig = PipelineConfig(),
+def _manifest_path(out_dir: str, date: str) -> str:
+    return os.path.join(out_dir, f"{date}_manifest.json")
+
+
+def _state_path(out_dir: str, date: str) -> str:
+    return os.path.join(out_dir, f"{date}_state.npz")
+
+
+def _dataset_fingerprint(dataset) -> dict:
+    """Dataset knobs that change output values (hashed into the manifest)."""
+    return {k: getattr(dataset, k, None)
+            for k in ("ch1", "ch2", "smoothing", "sg_window", "sg_order",
+                      "rescale_after", "rescale_value")}
+
+
+def _run_config_hash(cfg: PipelineConfig, method: str, x_is_channels: bool,
+                     dataset) -> str:
+    return config_hash(cfg, method, x_is_channels, _dataset_fingerprint(dataset))
+
+
+def _save_state(out_dir: str, date: str, chash: str,
+                acc: Optional[np.ndarray], done: dict) -> None:
+    """Atomically checkpoint the partial accumulator + done-chunk set.
+
+    This file is the single source of truth for which chunks the
+    accumulator already contains (the JSON manifest is reconciled from it
+    on resume), so a crash between the two writes can never double-count or
+    drop a chunk: the worst case is re-running work the manifest alone
+    would have remembered.
+    """
+    path = _state_path(out_dir, date)
+    os.makedirs(out_dir, exist_ok=True)
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, config_hash=np.str_(chash),
+             avg_image=(acc if acc is not None else np.zeros(0)),
+             keys=np.array(list(done), dtype=np.str_),
+             n_windows=np.array(list(done.values()), dtype=np.int64))
+    os.replace(tmp, path)
+
+
+def _load_state(out_dir: str, date: str, chash: str):
+    """Returns (acc, done_dict) or None when absent/stale/other-config."""
+    path = _state_path(out_dir, date)
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as f:
+            if str(f["config_hash"]) != chash:
+                return None
+            acc = np.asarray(f["avg_image"])
+            done = {str(k): int(n) for k, n in zip(f["keys"], f["n_windows"])}
+    except (KeyError, OSError, ValueError, zipfile_BadZipFile):
+        return None
+    return (acc if acc.size else None), done
+
+
+def run_directory(dataset: DirectoryDataset, cfg: Optional[PipelineConfig] = None,
                   method: str = "xcorr", x_is_channels: bool = True,
                   out_dir: Optional[str] = None, n_min_save: float = 30.0,
-                  max_chunks: Optional[int] = None) -> DirectoryResult:
-    """Process every time-window file of one date folder; chunks with zero
-    isolated vehicles are skipped, otherwise the chunk's average image is
-    *summed* into the accumulator (the reference's ``avg_image +=
-    images.avg_image``, imaging_workflow.py:67 — a sum of chunk averages, not
-    a vehicle-weighted mean).  The running sum is snapshotted to ``out_dir``
-    every ``n_min_save`` data-minutes worth of chunks (:68-74)."""
+                  max_chunks: Optional[int] = None,
+                  runtime: Optional[RuntimeConfig] = None,
+                  tracer=None, compute_fn=None) -> DirectoryResult:
+    """Process every time-window file of one date folder through the
+    pipelined runtime.  Chunks with zero isolated vehicles are skipped,
+    otherwise the chunk's average image is *summed* into the accumulator
+    (the reference's ``avg_image += images.avg_image``,
+    imaging_workflow.py:67 — a sum of chunk averages, not a vehicle-weighted
+    mean).  The running sum is snapshotted to ``out_dir`` every
+    ``n_min_save`` data-minutes worth of chunks (:68-74); with ``out_dir``
+    set, a resume manifest + per-chunk state checkpoint is maintained so an
+    interrupted run restarts at the first unprocessed chunk.
+
+    ``compute_fn`` swaps the per-chunk computation (default: the full
+    ``process_chunk`` imaging pipeline) for any callable
+    ``section -> (n_windows, image | None)`` — the extension point for
+    other chunk-level workloads riding the same prefetch / quarantine /
+    resume machinery.
+    """
+    cfg = cfg if cfg is not None else PipelineConfig()
+    runtime = runtime if runtime is not None else RuntimeConfig()
+    own_tracer = tracer is None
+    tracer = tracer if tracer is not None else make_tracer(runtime.trace_path)
     res = DirectoryResult()
-    acc = None
+    date = dataset.directory
+    t_start = time.perf_counter()
+
+    # --- manifest: load-or-invalidate, restore partial state ----------------
+    chash = _run_config_hash(cfg, method, x_is_channels, dataset)
+    manifest: Optional[RunManifest] = None
+    acc: Optional[np.ndarray] = None
+    done: dict = {}                      # key -> n_windows, in processed order
+    if out_dir:
+        manifest = RunManifest.load(_manifest_path(out_dir, date))
+        if manifest is not None and manifest.config_hash != chash:
+            log.warning("%s: config hash changed (%s -> %s); stale outputs "
+                        "invalidated, reprocessing", date,
+                        manifest.config_hash, chash)
+            manifest = None
+        st = _load_state(out_dir, date, chash)
+        if manifest is not None and st is not None:
+            acc, done = st
+        if manifest is None:
+            manifest = RunManifest(path=_manifest_path(out_dir, date),
+                                   config_hash=chash, date=date)
+        # reconcile: the state checkpoint is authoritative for done chunks
+        # (quarantine records stay manifest-side; a done entry the state
+        # never absorbed is dropped and recomputed)
+        for k in list(manifest.files):
+            if manifest.files[k]["status"] == "done" and k not in done:
+                del manifest.files[k]
+        for k, n in done.items():
+            manifest.mark_done(k, n)
+        manifest.complete = False
+        manifest.save()
+        res.n_resumed = sum(1 for p in dataset.files
+                            if manifest.is_settled(os.path.basename(p)))
+        if res.n_resumed:
+            log.info("%s: resuming — %d/%d chunks already settled", date,
+                     res.n_resumed, len(dataset.files))
+    state = {"n_vehicles": sum(done.values()),
+             "n_chunks": sum(1 for n in done.values() if n > 0)}
+
+    # --- build the remaining work list --------------------------------------
+    settled = (manifest.is_settled if manifest is not None
+               else (lambda key: False))
+    remaining = [(i, p) for i, p in enumerate(dataset.files)
+                 if not settled(os.path.basename(p))]
+    truncated = max_chunks is not None and len(remaining) > max_chunks
+    if truncated:
+        remaining = remaining[:max_chunks]
+
+    split_load = hasattr(dataset, "read") and hasattr(dataset, "preprocess")
+
+    def make_task(i: int, path: str) -> ChunkTask:
+        # index = absolute position in dataset.files, so snapshot tags and
+        # progress logs stay truthful across resumed runs
+        key = os.path.basename(path)
+
+        def load() -> DasSection:
+            if split_load:
+                with tracer.span("read", file=key):
+                    sec = dataset.read(i)
+                with tracer.span("preprocess", file=key):
+                    sec = dataset.preprocess(sec, i)
+            else:
+                with tracer.span("read", file=key):
+                    sec = dataset[i]
+            if runtime.device_put:
+                with tracer.span("device_put", file=key):
+                    sec = DasSection(jax.device_put(np.asarray(sec.data)),
+                                     sec.x, sec.t)
+            return sec
+
+        return ChunkTask(index=i, key=key, load=load)
+
+    tasks = [make_task(i, p) for i, p in remaining]
+
+    # --- snapshot cadence (reference n_min_save, imaging_workflow.py:68-74) --
     try:
         interval_s = dataset.time_interval()
     except ValueError:
         interval_s = n_min_save * 60.0
     n_win_save = max(int(n_min_save * 60.0 / interval_s), 1)
-    t_start = time.perf_counter()
-    for k, section in enumerate(dataset):
-        if max_chunks is not None and k >= max_chunks:
-            break
-        tic = time.perf_counter()
+
+    # --- the three runtime callbacks ----------------------------------------
+    def _default_compute(section: DasSection):
         chunk = process_chunk(section, cfg, method=method,
                               x_is_channels=x_is_channels)
         jax.block_until_ready(chunk.disp_image)
-        if chunk.n_windows == 0:
-            continue
-        img = np.asarray(chunk.disp_image)
-        acc = img if acc is None else acc + img
-        res.n_vehicles += chunk.n_windows
-        res.n_chunks += 1
-        log.info("chunk %d/%d: %d windows, %.2fs", k + 1, len(dataset),
-                 chunk.n_windows, time.perf_counter() - tic)
-        if out_dir and (k == 0 or (k + 1) % n_win_save == 0):
-            _save_snapshot(out_dir, dataset.directory, acc, res.n_vehicles,
-                           tag=f"win{k + 1}")
-            res.checkpoints.append(k + 1)
-    res.wall_s = time.perf_counter() - t_start
+        n = int(chunk.n_windows)
+        return n, (np.asarray(chunk.disp_image) if n > 0 else None)
+
+    chunk_fn = compute_fn if compute_fn is not None else _default_compute
+
+    def compute(section: DasSection):
+        tic = time.perf_counter()
+        n, img = chunk_fn(section)
+        return int(n), img, time.perf_counter() - tic
+
+    def checkpoint() -> None:
+        if out_dir:
+            _save_state(out_dir, date, chash, acc, done)  # state first: truth
+            manifest.save()
+
+    seq_done = {"n": 0}              # chunks accumulated THIS run
+
+    def accumulate(task: ChunkTask, result) -> None:
+        nonlocal acc
+        n, img, dt_chunk = result
+        if n > 0:
+            acc = img if acc is None else acc + img
+            state["n_vehicles"] += n
+            state["n_chunks"] += 1
+        done[task.key] = n
+        if manifest is not None:
+            manifest.mark_done(task.key, n)
+        seq_done["n"] += 1
+        log.info("chunk %s (%d/%d): %d windows, %.2fs", task.key,
+                 task.index + 1, len(dataset.files), n, dt_chunk)
+        tracer.counter("vehicles", total=state["n_vehicles"])
+        if seq_done["n"] % runtime.state_every == 0 or seq_done["n"] == len(tasks):
+            checkpoint()
+        if out_dir and acc is not None and \
+                (task.index == 0 or (task.index + 1) % n_win_save == 0):
+            _save_snapshot(out_dir, date, acc, state["n_vehicles"],
+                           tag=f"win{task.index + 1}")
+            res.checkpoints.append(task.index + 1)
+
+    def on_quarantine(rec) -> None:
+        if manifest is not None:
+            manifest.mark_quarantined(rec.key, rec.stage, rec.error,
+                                      rec.retries)
+        checkpoint()
+
+    n_veh0 = state["n_vehicles"]
+    stats = run_pipelined(tasks, compute, accumulate, cfg=runtime,
+                          tracer=tracer, on_quarantine=on_quarantine)
+
+    # --- completion + result -------------------------------------------------
     res.avg_image = acc
+    res.n_vehicles = state["n_vehicles"]
+    res.n_chunks = state["n_chunks"]
+    res.quarantined = list(stats.quarantined)
+    res.n_retries = stats.n_retries
+    res.complete = not truncated
+    if manifest is not None:
+        res.complete = res.complete and all(
+            manifest.is_settled(os.path.basename(p)) for p in dataset.files)
+        manifest.complete = res.complete
+        checkpoint()
+    res.wall_s = time.perf_counter() - t_start
+    n_processed = stats.n_done + len(stats.quarantined)
+    if stats.wall_s > 0 and n_processed:
+        res.chunks_per_s = n_processed / stats.wall_s
+        res.vehicles_per_s = (state["n_vehicles"] - n_veh0) / stats.wall_s
+    if own_tracer:
+        tracer.close()
     return res
 
 
@@ -94,35 +312,70 @@ def _save_snapshot(out_dir: str, date: str, avg_image: np.ndarray,
                    n_vehicles: int, tag: str = "final") -> str:
     os.makedirs(out_dir, exist_ok=True)
     path = os.path.join(out_dir, f"{date}_{tag}.npz")
-    np.savez(path, avg_image=avg_image, n_vehicles=n_vehicles)
+    tmp = path + ".tmp.npz"          # atomic: resume reads this file unguarded
+    np.savez(tmp, avg_image=avg_image, n_vehicles=n_vehicles)
+    os.replace(tmp, path)
     return path
 
 
 def run_date_range(root: str, start_date: str, end_date: str,
-                   cfg: PipelineConfig = PipelineConfig(), method: str = "xcorr",
+                   cfg: Optional[PipelineConfig] = None, method: str = "xcorr",
                    out_dir: str = "results", n_min_save: float = 30.0,
                    max_chunks: Optional[int] = None, x_is_channels: bool = True,
+                   runtime: Optional[RuntimeConfig] = None,
                    **dataset_kwargs) -> dict:
-    """Run every date folder in [start_date, end_date]; resume by skipping
-    dates whose final output exists (reference imaging_workflow.py:189-191)."""
+    """Run every date folder in [start_date, end_date] through the runtime.
+
+    Resume is manifest-driven: a date is skipped only when its manifest says
+    the run completed under the *same* config hash (or, for pre-manifest
+    outputs, when the final .npz exists) — and skipped dates still report
+    their ``n_vehicles`` from the existing final .npz so resumed and fresh
+    runs are comparable.  A config change invalidates stale outputs and
+    reprocesses; an interrupted date resumes mid-directory.
+    """
+    cfg = cfg if cfg is not None else PipelineConfig()
+    runtime = runtime if runtime is not None else RuntimeConfig()
+    tracer = make_tracer(runtime.trace_path)
     summary = {}
-    for date in date_range(start_date, end_date):
-        folder = os.path.join(root, date)
-        final_path = os.path.join(out_dir, f"{date}_final.npz")
-        if not os.path.isdir(folder):
-            log.info("%s: no data folder, skipping", date)
-            continue
-        if os.path.exists(final_path):
-            log.info("%s: output exists, skipping (resume)", date)
-            summary[date] = {"skipped": True}
-            continue
-        dataset = DirectoryDataset(directory=date, root=root, **dataset_kwargs)
-        res = run_directory(dataset, cfg, method=method, out_dir=out_dir,
-                            n_min_save=n_min_save, max_chunks=max_chunks,
-                            x_is_channels=x_is_channels)
-        if res.avg_image is not None:
-            _save_snapshot(out_dir, date, res.avg_image, res.n_vehicles)
-        summary[date] = {"n_vehicles": res.n_vehicles, "n_chunks": res.n_chunks,
-                         "wall_s": round(res.wall_s, 2)}
-        log.info("%s: %s", date, json.dumps(summary[date]))
+    try:
+        for date in date_range(start_date, end_date):
+            folder = os.path.join(root, date)
+            final_path = os.path.join(out_dir, f"{date}_final.npz")
+            if not os.path.isdir(folder):
+                log.info("%s: no data folder, skipping", date)
+                continue
+            dataset = DirectoryDataset(directory=date, root=root,
+                                       **dataset_kwargs)
+            chash = _run_config_hash(cfg, method, x_is_channels, dataset)
+            man = RunManifest.load(_manifest_path(out_dir, date))
+            man_done = man is not None and man.config_hash == chash and man.complete
+            if os.path.exists(final_path) and (man is None or man_done):
+                # completed under this config (or a legacy pre-manifest run)
+                try:
+                    with np.load(final_path) as f:
+                        n_veh = int(f["n_vehicles"])
+                except (KeyError, OSError, ValueError, zipfile_BadZipFile) as e:
+                    log.warning("%s: final output unreadable (%s); "
+                                "reprocessing the date", date, e)
+                else:
+                    log.info("%s: complete output exists, skipping (resume)",
+                             date)
+                    summary[date] = {"skipped": True, "n_vehicles": n_veh}
+                    continue
+            res = run_directory(dataset, cfg, method=method, out_dir=out_dir,
+                                n_min_save=n_min_save, max_chunks=max_chunks,
+                                x_is_channels=x_is_channels, runtime=runtime,
+                                tracer=tracer)
+            if res.complete and res.avg_image is not None:
+                _save_snapshot(out_dir, date, res.avg_image, res.n_vehicles)
+            summary[date] = {"n_vehicles": res.n_vehicles,
+                             "n_chunks": res.n_chunks,
+                             "wall_s": round(res.wall_s, 2),
+                             "chunks_per_s": round(res.chunks_per_s, 3),
+                             "n_quarantined": len(res.quarantined),
+                             "n_resumed": res.n_resumed,
+                             "complete": res.complete}
+            log.info("%s: %s", date, json.dumps(summary[date]))
+    finally:
+        tracer.close()
     return summary

@@ -1,0 +1,31 @@
+"""Pipelined batch-execution runtime.
+
+Four concerns, one module each:
+
+- :mod:`prefetch` — bounded-queue background loader overlapping host npz
+  read + preprocess + ``device_put`` with device compute;
+- :mod:`executor` — per-chunk retry/backoff and quarantine (a corrupt file
+  costs one chunk, not the date), ordered bit-exact accumulation;
+- :mod:`manifest` — config-hash-keyed resume manifest + partial-state
+  checkpoints for exact mid-date restart;
+- :mod:`tracing` — Chrome-trace-format JSONL span events and throughput
+  counters.
+
+The batch workflows (``pipeline.workflow``) and the CLI are thin callers of
+this package; it has no knowledge of DAS specifics beyond "a chunk loads,
+computes, accumulates".
+"""
+
+from das_diff_veh_tpu.runtime.config import RuntimeConfig
+from das_diff_veh_tpu.runtime.executor import (ChunkTask, ExecStats,
+                                               QuarantineRecord, run_pipelined)
+from das_diff_veh_tpu.runtime.manifest import RunManifest, config_hash
+from das_diff_veh_tpu.runtime.prefetch import PrefetchLoader
+from das_diff_veh_tpu.runtime.tracing import (NullTracer, TraceWriter,
+                                              load_trace, make_tracer)
+
+__all__ = [
+    "RuntimeConfig", "ChunkTask", "ExecStats", "QuarantineRecord",
+    "run_pipelined", "RunManifest", "config_hash", "PrefetchLoader",
+    "NullTracer", "TraceWriter", "load_trace", "make_tracer",
+]

@@ -1,0 +1,43 @@
+"""Execution-runtime knobs, separate from the numerical PipelineConfig.
+
+PipelineConfig is static-under-jit physics; RuntimeConfig is how the batch
+loop *executes* — prefetch depth, retry policy, manifest cadence, tracing.
+Changing it never changes a single output bit, so it is deliberately
+excluded from the resume manifest's config hash.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class RuntimeConfig:
+    """How the pipelined batch executor runs one directory of chunks."""
+
+    prefetch_depth: int = 2
+    """Chunks the background loader may stage ahead of the TPU (bounded
+    queue).  0 disables the loader thread entirely: loads run inline on the
+    main thread (the serial reference behavior, and the bench baseline)."""
+
+    max_retries: int = 1
+    """Extra attempts per chunk per stage (load and compute retry
+    independently) before the chunk is quarantined."""
+
+    retry_backoff_s: float = 0.05
+    """Sleep before retry attempt k is ``k * retry_backoff_s`` (linear
+    backoff; transient NFS/device hiccups clear in well under a second)."""
+
+    device_put: bool = True
+    """Stage the loaded waterfall onto the default device from the loader
+    thread (`jax.device_put`), overlapping H2D transfer with compute."""
+
+    state_every: int = 1
+    """Write the resume manifest + partial-accumulator state every N
+    completed chunks.  1 (default) gives exact single-chunk-granularity
+    resume; raise it if manifest I/O ever shows up in traces."""
+
+    trace_path: Optional[str] = None
+    """Write Chrome-trace-format JSONL span events here (read / preprocess /
+    compute / accumulate, plus throughput counters).  None disables."""

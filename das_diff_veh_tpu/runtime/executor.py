@@ -1,0 +1,147 @@
+"""Pipelined chunk executor: prefetch + retry/backoff + quarantine + spans.
+
+One generic loop used by every batch workflow: a sequence of ``ChunkTask``s
+(host-side ``load`` thunks) is streamed through a ``PrefetchLoader`` while
+the main thread runs ``compute`` (device work) and ``accumulate`` (ordered
+reduction) per chunk.  Failures are isolated per chunk: the failing stage is
+retried with linear backoff up to ``RuntimeConfig.max_retries`` times, and a
+chunk that still fails lands on the quarantine list — costing one chunk, not
+the run.
+
+Accumulation happens on the main thread in task-submission order, so results
+are bit-identical to the serial loop regardless of prefetch depth.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Sequence
+
+from das_diff_veh_tpu.runtime.config import RuntimeConfig
+from das_diff_veh_tpu.runtime.prefetch import PrefetchLoader
+from das_diff_veh_tpu.runtime.tracing import NullTracer
+
+log = logging.getLogger("das_diff_veh_tpu.runtime")
+
+
+@dataclass
+class ChunkTask:
+    """One unit of work: a manifest key plus a host-side load thunk."""
+
+    index: int
+    key: str
+    load: Callable[[], Any]
+
+
+@dataclass
+class QuarantineRecord:
+    key: str
+    stage: str          # "load" or "compute"
+    error: str
+    retries: int
+
+
+@dataclass
+class ExecStats:
+    n_done: int = 0
+    n_retries: int = 0
+    quarantined: List[QuarantineRecord] = field(default_factory=list)
+    wall_s: float = 0.0
+
+    @property
+    def chunks_per_s(self) -> float:
+        return self.n_done / self.wall_s if self.wall_s > 0 else 0.0
+
+
+def _retrying(fn: Callable[[], Any], stage: str, key: str, cfg: RuntimeConfig,
+              tracer, stats: ExecStats, prior_error: Optional[Exception] = None):
+    """Run ``fn`` with up to max_retries extra attempts; returns
+    (value, error, n_retries_used).  ``prior_error`` marks an attempt that
+    already failed elsewhere (the prefetch thread), so every call here is a
+    counted, backed-off retry."""
+    err: Optional[Exception] = prior_error
+    first = 1 if prior_error is not None else 0
+    for attempt in range(first, cfg.max_retries + 1):
+        if attempt:
+            stats.n_retries += 1
+            tracer.instant("retry", stage=stage, key=key, attempt=attempt)
+            time.sleep(cfg.retry_backoff_s * attempt)
+            log.warning("%s: retrying %s (attempt %d/%d): %s", key, stage,
+                        attempt, cfg.max_retries, err)
+        try:
+            return fn(), None, attempt
+        except Exception as e:
+            err = e
+    return None, err, cfg.max_retries
+
+
+def run_pipelined(tasks: Sequence[ChunkTask],
+                  compute: Callable[[Any], Any],
+                  accumulate: Callable[[ChunkTask, Any], None],
+                  cfg: Optional[RuntimeConfig] = None,
+                  tracer=None,
+                  on_quarantine: Optional[Callable[[QuarantineRecord], None]] = None,
+                  ) -> ExecStats:
+    """Execute every task; never raises for a per-chunk failure.
+
+    ``compute`` runs device work for one loaded value; ``accumulate`` folds
+    its result into caller state (called in task order).  ``on_quarantine``
+    fires once per permanently-failed chunk (manifest bookkeeping).
+    """
+    cfg = cfg or RuntimeConfig()
+    tracer = tracer or NullTracer()
+    stats = ExecStats()
+    loader = PrefetchLoader([t.load for t in tasks], depth=cfg.prefetch_depth)
+    t_start = time.perf_counter()
+    try:
+        pending = iter(loader)
+        while True:
+            with tracer.span("input_wait"):
+                nxt = next(pending, None)
+            if nxt is None:
+                break
+            idx, value, err = nxt
+            task = tasks[idx]
+            retries = 0
+            if err is not None:
+                # the prefetched attempt was attempt 0; retry inline from 1
+                log.warning("%s: load failed: %s", task.key, err)
+                value, err, retries = _retrying(task.load, "load", task.key,
+                                                cfg, tracer, stats,
+                                                prior_error=err)
+            if err is not None:
+                rec = QuarantineRecord(task.key, "load", f"{type(err).__name__}: {err}",
+                                       retries)
+                stats.quarantined.append(rec)
+                log.error("%s: quarantined after load failure: %s", task.key, rec.error)
+                if on_quarantine:
+                    on_quarantine(rec)
+                continue
+
+            def _compute(v=value):
+                with tracer.span("compute", key=task.key):
+                    return compute(v)
+
+            result, err, retries = _retrying(_compute, "compute", task.key,
+                                             cfg, tracer, stats)
+            if err is not None:
+                rec = QuarantineRecord(task.key, "compute",
+                                       f"{type(err).__name__}: {err}", retries)
+                stats.quarantined.append(rec)
+                log.error("%s: quarantined after compute failure: %s",
+                          task.key, rec.error)
+                if on_quarantine:
+                    on_quarantine(rec)
+                continue
+
+            with tracer.span("accumulate", key=task.key):
+                accumulate(task, result)
+            stats.n_done += 1
+            tracer.counter("chunks", done=stats.n_done,
+                           quarantined=len(stats.quarantined))
+    finally:
+        loader.close()
+    stats.wall_s = time.perf_counter() - t_start
+    return stats

@@ -1,6 +1,5 @@
 import numpy as np
 import jax.numpy as jnp
-import pytest
 from scipy import signal as sp_signal
 
 from das_diff_veh_tpu import ops

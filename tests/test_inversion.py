@@ -5,7 +5,6 @@ profile recovery (SURVEY §7 step 10; reference inversion_diff_*.ipynb)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from das_diff_veh_tpu.inversion import (Curve, LayerBounds, LayeredModel,
                                         ModelSpec, curves_from_ridges,

@@ -1,13 +1,9 @@
-import logging
-import os
-
 import numpy as np
-import jax.numpy as jnp
 import pytest
 
 from das_diff_veh_tpu.config import ImagingConfig, PipelineConfig
 from das_diff_veh_tpu.core.section import DasSection
-from das_diff_veh_tpu.io.readers import DirectoryDataset, save_section_npz
+from das_diff_veh_tpu.io.readers import save_section_npz
 from das_diff_veh_tpu.io.synthetic import SceneConfig, synthesize_section
 from das_diff_veh_tpu.pipeline.timelapse import process_chunk
 from das_diff_veh_tpu.pipeline.workflow import date_range, run_date_range
@@ -53,11 +49,13 @@ def test_run_date_range_with_resume(tmp_path, scene, caplog):
     section, _ = scene
     day = tmp_path / "20230301"
     day.mkdir()
-    # two chunk files, 2 min apart
+    # two chunk files, 2 min apart, plus one corrupt file the runtime must
+    # quarantine without aborting the date
     sec = DasSection(np.asarray(section.data), np.asarray(section.x),
                      np.asarray(section.t))
     save_section_npz(str(day / "20230301_000000.npz"), sec)
     save_section_npz(str(day / "20230301_000200.npz"), sec)
+    (day / "20230301_000400.npz").write_bytes(b"corrupt bytes, not an npz")
 
     out = tmp_path / "results"
     kwargs = dict(ch1=None, ch2=None, smoothing=False, rescale_after=None,
@@ -66,16 +64,20 @@ def test_run_date_range_with_resume(tmp_path, scene, caplog):
                              cfg=_cfg(), method="xcorr", out_dir=str(out),
                              **kwargs)
     assert summary["20230301"]["n_chunks"] == 2
+    assert summary["20230301"]["n_quarantined"] == 1
+    assert summary["20230301"]["complete"] is True
     final = out / "20230301_final.npz"
     assert final.exists()
     with np.load(final) as f:
+        n_vehicles = int(f["n_vehicles"])
         assert np.isfinite(f["avg_image"]).all()
-        assert f["n_vehicles"] > 0
-    # resume: second run skips
+        assert n_vehicles > 0
+    # resume: second run skips, but still reports the date's n_vehicles so
+    # resumed and fresh runs are comparable
     summary2 = run_date_range(str(tmp_path), "20230301", "20230302",
                               cfg=_cfg(), method="xcorr", out_dir=str(out),
                               **kwargs)
-    assert summary2["20230301"] == {"skipped": True}
+    assert summary2["20230301"] == {"skipped": True, "n_vehicles": n_vehicles}
 
 
 def test_run_date_range_missing_folder(tmp_path):

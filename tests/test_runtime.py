@@ -1,0 +1,400 @@
+"""Runtime subsystem tests: prefetch, fault isolation, resume manifest, traces.
+
+The integration tests drive the real ``run_directory`` workflow (real npz
+I/O through DirectoryDataset, real manifest/state checkpoints, real
+prefetch threads) with a cheap deterministic ``compute_fn`` so bit-identity
+of the accumulator under faults/resume is asserted without paying the full
+imaging pipeline per chunk — ``tests/test_pipeline.py`` covers the
+integrated real-compute path (including a quarantined corrupt file).
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from das_diff_veh_tpu.config import ImagingConfig, PipelineConfig
+from das_diff_veh_tpu.core.section import DasSection
+from das_diff_veh_tpu.io.readers import DirectoryDataset, save_section_npz
+from das_diff_veh_tpu.pipeline.workflow import run_directory
+from das_diff_veh_tpu.runtime import (ChunkTask, PrefetchLoader, RunManifest,
+                                      RuntimeConfig, TraceWriter, config_hash,
+                                      load_trace, run_pipelined)
+
+DATE = "20230301"
+
+
+# --------------------------------------------------------------------------
+# helpers
+# --------------------------------------------------------------------------
+
+def _section(scale: float) -> DasSection:
+    rng = np.random.default_rng(7)
+    data = rng.standard_normal((8, 256)) * scale
+    return DasSection(data, np.arange(8.0), np.arange(256) / 250.0)
+
+
+def _write_dir(root, scales, corrupt=()):
+    """Write one date folder of tiny npz chunks; ``corrupt`` indices get
+    garbage bytes instead of a valid npz."""
+    day = os.path.join(str(root), DATE)
+    os.makedirs(day, exist_ok=True)
+    for i, s in enumerate(scales):
+        path = os.path.join(day, f"{DATE}_{i:02d}0000.npz")
+        if i in corrupt:
+            with open(path, "wb") as f:
+                f.write(b"this is not an npz file")
+        else:
+            save_section_npz(path, _section(s))
+    return str(root)
+
+
+def _fake_compute(section):
+    """Deterministic stand-in for process_chunk: (1 vehicle, 4x4 image)."""
+    d = np.asarray(section.data)
+    return 1, np.outer(d.mean(axis=1)[:4], d.std(axis=1)[:4] + 1.0)
+
+
+def _dataset(root):
+    return DirectoryDataset(DATE, root=root, ch1=None, ch2=None,
+                            smoothing=False, rescale_after=None)
+
+
+def _run(root, out=None, compute=_fake_compute, runtime=None, **kw):
+    return run_directory(_dataset(root), out_dir=out, compute_fn=compute,
+                         runtime=runtime or RuntimeConfig(), **kw)
+
+
+# --------------------------------------------------------------------------
+# prefetch
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("depth", [0, 1, 4])
+def test_prefetch_loader_preserves_order(depth):
+    loader = PrefetchLoader([lambda i=i: i * i for i in range(12)], depth=depth)
+    out = list(loader)
+    assert [v for _, v, _ in out] == [i * i for i in range(12)]
+    assert all(e is None for _, _, e in out)
+    loader.close()
+
+
+def test_prefetch_loader_runs_in_background_thread():
+    names = []
+
+    def load():
+        names.append(threading.current_thread().name)
+        return 1
+
+    loader = PrefetchLoader([load] * 3, depth=2)
+    assert [v for _, v, _ in loader] == [1, 1, 1]
+    assert all(n != "MainThread" for n in names)
+    loader.close()
+
+
+def test_prefetch_loader_delivers_errors_in_band():
+    def bad():
+        raise OSError("boom")
+
+    loader = PrefetchLoader([lambda: 1, bad, lambda: 3], depth=2)
+    out = list(loader)
+    assert out[0][1] == 1 and out[2][1] == 3
+    assert isinstance(out[1][2], OSError)
+    loader.close()
+
+
+# --------------------------------------------------------------------------
+# executor: retry / quarantine
+# --------------------------------------------------------------------------
+
+def test_executor_retries_transient_then_succeeds():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "v"
+
+    acc = []
+    stats = run_pipelined([ChunkTask(0, "a", flaky)], compute=lambda v: v + "!",
+                          accumulate=lambda t, r: acc.append(r),
+                          cfg=RuntimeConfig(max_retries=2, retry_backoff_s=0.0))
+    assert acc == ["v!"] and stats.n_done == 1
+    assert stats.n_retries == 2 and not stats.quarantined
+
+
+def test_executor_quarantines_bad_chunk_and_continues():
+    def compute(v):
+        if v == "bad":
+            raise ValueError("shape mismatch")
+        return v
+
+    acc = []
+    tasks = [ChunkTask(i, k, lambda k=k: k) for i, k in
+             enumerate(["a", "bad", "c"])]
+    quar = []
+    stats = run_pipelined(tasks, compute, lambda t, r: acc.append(r),
+                          cfg=RuntimeConfig(max_retries=1, retry_backoff_s=0.0),
+                          on_quarantine=quar.append)
+    assert acc == ["a", "c"]
+    assert [q.key for q in stats.quarantined] == ["bad"]
+    assert stats.quarantined[0].stage == "compute"
+    assert "ValueError" in stats.quarantined[0].error
+    assert quar == stats.quarantined
+
+
+def test_executor_zero_retries_means_single_attempt():
+    calls = {"n": 0}
+
+    def bad():
+        calls["n"] += 1
+        raise OSError("nope")
+
+    stats = run_pipelined([ChunkTask(0, "a", bad)], compute=lambda v: v,
+                          accumulate=lambda t, r: None,
+                          cfg=RuntimeConfig(prefetch_depth=2, max_retries=0,
+                                            retry_backoff_s=0.0))
+    assert calls["n"] == 1 and stats.n_retries == 0
+    assert [q.stage for q in stats.quarantined] == ["load"]
+
+
+# --------------------------------------------------------------------------
+# tracing
+# --------------------------------------------------------------------------
+
+def test_trace_writer_chrome_format(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    tw = TraceWriter(path)
+    with tw.span("read", file="f0.npz"):
+        with tw.span("inner"):
+            pass
+
+    def worker():
+        with tw.span("preprocess"):
+            pass
+
+    th = threading.Thread(target=worker)
+    th.start()
+    th.join()
+    tw.counter("chunks", done=1, quarantined=0)
+    tw.instant("retry", stage="load")
+    tw.close()
+
+    events = load_trace(path)           # raises on any malformed line
+    assert {e["ph"] for e in events} >= {"X", "C", "M", "i"}
+    x = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in x} == {"read", "inner", "preprocess"}
+    assert all(e["dur"] >= 0 for e in x)
+    assert len({e["tid"] for e in x}) == 2          # two threads
+    # every line is standalone JSON (crash-safe JSONL)
+    with open(path) as f:
+        for line in f:
+            json.loads(line)
+
+
+# --------------------------------------------------------------------------
+# manifest
+# --------------------------------------------------------------------------
+
+def test_manifest_roundtrip(tmp_path):
+    path = str(tmp_path / "m.json")
+    m = RunManifest(path=path, config_hash=config_hash(PipelineConfig()),
+                    date=DATE)
+    m.mark_done("a.npz", 3)
+    m.mark_done("b.npz", 0)
+    m.mark_quarantined("c.npz", "load", "BadZipFile: bad magic", retries=2)
+    m.save()
+    m2 = RunManifest.load(path)
+    assert m2.config_hash == m.config_hash
+    assert m2.n_vehicles == 3 and m2.n_chunks == 1
+    assert m2.is_settled("a.npz") and m2.is_settled("c.npz")
+    assert not m2.is_settled("d.npz")
+    assert list(m2.quarantined) == ["c.npz"]
+
+
+def test_config_hash_sensitivity():
+    a = config_hash(PipelineConfig(), "xcorr", True)
+    b = config_hash(PipelineConfig().replace(
+        imaging=ImagingConfig(x0=500.0)), "xcorr", True)
+    c = config_hash(PipelineConfig(), "surface_wave", True)
+    assert len({a, b, c}) == 3
+    assert a == config_hash(PipelineConfig(), "xcorr", True)
+
+
+# --------------------------------------------------------------------------
+# run_directory integration: fault isolation
+# --------------------------------------------------------------------------
+
+def test_fault_injection_bit_identical_average(tmp_path):
+    """A corrupt npz mid-directory costs exactly that chunk: the run
+    completes, the file is quarantined, and the accumulated average is
+    bit-identical to a run over a directory without the file."""
+    root_a = _write_dir(tmp_path / "a", [1.0, 1.1, 1.2, 1.3], corrupt=(1,))
+    root_b = _write_dir(tmp_path / "b", [1.0, 1.2, 1.3])
+
+    out = str(tmp_path / "res_a")
+    res_a = _run(root_a, out=out,
+                 runtime=RuntimeConfig(max_retries=1, retry_backoff_s=0.0))
+    res_b = _run(root_b)
+
+    assert [q.key for q in res_a.quarantined] == [f"{DATE}_010000.npz"]
+    assert res_a.quarantined[0].stage == "load"
+    assert res_a.n_chunks == 3 and res_a.complete
+    assert np.array_equal(res_a.avg_image, res_b.avg_image)
+    assert res_a.n_vehicles == res_b.n_vehicles == 3
+
+    man = RunManifest.load(os.path.join(out, f"{DATE}_manifest.json"))
+    assert man.complete and list(man.quarantined) == [f"{DATE}_010000.npz"]
+
+    # a second run over the same out_dir retries nothing — quarantined and
+    # done chunks are settled; the accumulator is restored from the state
+    calls = {"n": 0}
+
+    def counting(section):
+        calls["n"] += 1
+        return _fake_compute(section)
+
+    res_c = _run(root_a, out=out, compute=counting)
+    assert calls["n"] == 0 and res_c.n_resumed == 4
+    assert np.array_equal(res_c.avg_image, res_a.avg_image)
+
+
+# --------------------------------------------------------------------------
+# run_directory integration: kill / restart via the manifest
+# --------------------------------------------------------------------------
+
+def test_kill_restart_resume_bit_identical(tmp_path):
+    scales = [1.0, 1.5, 2.0, 2.5]
+    root = _write_dir(tmp_path / "d", scales)
+    out_int = str(tmp_path / "res_int")
+    out_ref = str(tmp_path / "res_ref")
+
+    # uninterrupted reference run
+    ref = _run(root, out=out_ref)
+    assert ref.n_chunks == 4 and ref.complete
+
+    # hard-kill the run mid-date (after 2 chunks committed)
+    calls = {"n": 0}
+
+    def killed(section):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            raise KeyboardInterrupt
+        return _fake_compute(section)
+
+    with pytest.raises(KeyboardInterrupt):
+        _run(root, out=out_int, compute=killed)
+    man = RunManifest.load(os.path.join(out_int, f"{DATE}_manifest.json"))
+    assert not man.complete and man.n_chunks == 2
+
+    # restart: only the remaining chunks are processed
+    calls2 = {"n": 0}
+
+    def counting(section):
+        calls2["n"] += 1
+        return _fake_compute(section)
+
+    res = _run(root, out=out_int, compute=counting)
+    assert calls2["n"] == 2 and res.n_resumed == 2
+    assert res.complete and res.n_chunks == 4
+    assert np.array_equal(res.avg_image, ref.avg_image)
+    assert res.n_vehicles == ref.n_vehicles == 4
+
+
+def test_max_chunks_truncates_then_resumes(tmp_path):
+    root = _write_dir(tmp_path / "d", [1.0, 1.5, 2.0])
+    out = str(tmp_path / "res")
+    res1 = _run(root, out=out, max_chunks=2)
+    assert res1.n_chunks == 2 and not res1.complete
+    res2 = _run(root, out=out)
+    assert res2.n_resumed == 2 and res2.complete and res2.n_chunks == 3
+    full = _run(root)
+    assert np.array_equal(res2.avg_image, full.avg_image)
+
+
+def test_config_change_invalidates_resume(tmp_path):
+    root = _write_dir(tmp_path / "d", [1.0, 1.5])
+    out = str(tmp_path / "res")
+    res1 = _run(root, out=out)
+    assert res1.complete and res1.n_chunks == 2
+
+    calls = {"n": 0}
+
+    def counting(section):
+        calls["n"] += 1
+        return _fake_compute(section)
+
+    # same config: nothing recomputed
+    _run(root, out=out, compute=counting)
+    assert calls["n"] == 0
+    # changed config: stale outputs invalidated, everything recomputed
+    res3 = _run(root, out=out, compute=counting,
+                cfg=PipelineConfig().replace(imaging=ImagingConfig(x0=500.0)))
+    assert calls["n"] == 2 and res3.n_resumed == 0 and res3.complete
+
+
+def test_stale_manifest_done_entry_is_recomputed(tmp_path):
+    """A manifest 'done' entry the state checkpoint never absorbed (crash
+    between the two writes) is dropped and recomputed — never double-counted,
+    never silently missing from the accumulator."""
+    root = _write_dir(tmp_path / "d", [1.0, 1.5])
+    out = str(tmp_path / "res")
+    res1 = _run(root, out=out, max_chunks=1)
+    assert res1.n_chunks == 1
+    # forge the crash window: manifest claims chunk 2 done, state lacks it
+    mpath = os.path.join(out, f"{DATE}_manifest.json")
+    man = RunManifest.load(mpath)
+    man.mark_done(f"{DATE}_010000.npz", 1)
+    man.save()
+
+    res2 = _run(root, out=out)
+    full = _run(root)
+    assert res2.n_chunks == 2
+    assert np.array_equal(res2.avg_image, full.avg_image)
+
+
+# --------------------------------------------------------------------------
+# run_directory integration: trace output
+# --------------------------------------------------------------------------
+
+def test_run_directory_emits_valid_chrome_trace(tmp_path):
+    root = _write_dir(tmp_path / "d", [1.0, 1.5])
+    trace = str(tmp_path / "trace.jsonl")
+    res = _run(root, runtime=RuntimeConfig(prefetch_depth=2, trace_path=trace))
+    assert res.n_chunks == 2
+    events = load_trace(trace)          # validates every line
+    spans = {e["name"] for e in events if e["ph"] == "X"}
+    assert {"read", "preprocess", "device_put", "compute",
+            "accumulate"} <= spans
+    counters = {e["name"] for e in events if e["ph"] == "C"}
+    assert {"chunks", "vehicles"} <= counters
+    # loader spans and compute spans come from different threads
+    tids = {e["tid"] for e in events
+            if e["ph"] == "X" and e["name"] in ("read", "compute")}
+    assert len(tids) == 2
+    assert res.chunks_per_s > 0
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+def test_cli_runtime_flags():
+    from das_diff_veh_tpu.pipeline.cli import build_parser
+    args = build_parser().parse_args(
+        ["--data_root", "/d", "--start_date", DATE, "--end_date", DATE,
+         "--max_chunks", "5", "--prefetch_depth", "4", "--retries", "2",
+         "--retry_backoff", "0.5", "--trace", "/tmp/t.jsonl"])
+    assert args.max_chunks == 5 and args.prefetch_depth == 4
+    assert args.retries == 2 and args.retry_backoff == 0.5
+    assert args.trace == "/tmp/t.jsonl"
+
+
+def test_cli_missing_args_errors_cleanly(capsys):
+    from das_diff_veh_tpu.pipeline.cli import main
+    with pytest.raises(SystemExit) as exc:
+        main(["--start_date", DATE])
+    assert exc.value.code == 2
+    assert "required unless --figures" in capsys.readouterr().err

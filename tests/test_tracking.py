@@ -3,7 +3,7 @@ import jax.numpy as jnp
 import pytest
 from scipy.signal import find_peaks as scipy_find_peaks
 
-from das_diff_veh_tpu.config import TrackingConfig, TrackQCConfig
+from das_diff_veh_tpu.config import TrackingConfig
 from das_diff_veh_tpu.models import tracking as T
 from das_diff_veh_tpu.ops import peaks as P
 from das_diff_veh_tpu.oracle import tracking_ref as OT

@@ -3,7 +3,6 @@ norm_part re-normalization matches the reference algebra
 (modules/utils.py:528-543)."""
 
 import numpy as np
-import pytest
 
 import matplotlib
 matplotlib.use("Agg")
