@@ -21,10 +21,15 @@ parity vs the unsharded kernel, and a minutes-long (nt = 61440) record
 through the win_block-streamed kernel with its record-length-invariance
 ratio.  An end-to-end batch-runtime entry measures chunks/s of the serial loop vs
 the prefetching executor on a synthetic compressed-npz directory
-(``e2e_*`` keys; BENCH_E2E_FILES/REPS/DEPTH tune it).  Opt-outs:
-BENCH_SKIP_E2E / BENCH_SKIP_PALLAS / BENCH_SKIP_SHARDED / BENCH_SKIP_LONG /
-BENCH_SKIP_10K; BENCH_10K_SRC_CHUNK tunes the 10k source-chunk size
-(default 32 — see docs/PERF.md on the working-set effect).
+(``e2e_*`` keys; BENCH_E2E_FILES/REPS/DEPTH tune it).  An online-serving
+entry (``serve_*`` keys) drives an open-loop variable-shape request load
+through naive per-request execution vs the microbatched shape-bucketed
+serving engine (``das_diff_veh_tpu.serve``), reporting p50/p99 latency and
+req/s for both plus the engine's steady-state compile count (asserted 0);
+BENCH_SERVE_REQS/SHAPES/INTERARRIVAL_MS/NCH/NT tune the load.  Opt-outs:
+BENCH_SKIP_E2E / BENCH_SKIP_SERVE / BENCH_SKIP_PALLAS / BENCH_SKIP_SHARDED /
+BENCH_SKIP_LONG / BENCH_SKIP_10K; BENCH_10K_SRC_CHUNK tunes the 10k
+source-chunk size (default 32 — see docs/PERF.md on the working-set effect).
 
 Prints ONE JSON line with the primary metric plus an ``extra`` dict:
   {"metric": "vsg_disp_700m_build", "value": <s>, "unit": "s",
@@ -324,6 +329,126 @@ def main() -> None:
             extra["e2e_prefetch_speedup"] = round(prefetch / serial, 3)
         finally:
             shutil.rmtree(tdir, ignore_errors=True)
+
+    # --- online serving: naive per-request vs microbatched+bucketed engine ----
+    # Open-loop load (fixed arrival schedule, latency includes queueing) of
+    # requests whose nt varies across BENCH_SERVE_SHAPES variants.  The naive
+    # server calls the jitted program directly on each request's exact shape
+    # (one warmup on the first shape — a deployment that warmed its nominal
+    # shape but receives variable-length segments), so every novel shape
+    # pays a trace+compile inline and the requests queued behind it eat the
+    # delay.  The engine pads everything to ONE bucket warmed ahead of time:
+    # zero steady-state compiles (asserted via its cache-miss counter).  The
+    # compute is a mid-weight real slice of the pipeline (surface-wave band
+    # conditioning + f-v transform) so the bench stays minutes-scale on CPU
+    # smoke runs; the compile-per-shape cost it amortizes is the same
+    # phenomenon that costs ~40 s/shape for full process_chunk.
+    if not os.environ.get("BENCH_SKIP_SERVE"):
+        from das_diff_veh_tpu.config import (DispersionConfig as _DC,
+                                             PipelineConfig as _PC,
+                                             ServeConfig)
+        from das_diff_veh_tpu.core.section import DasSection
+        from das_diff_veh_tpu.ops.dispersion import fv_map_fk
+        from das_diff_veh_tpu.pipeline.preprocess import preprocess_for_surface_waves
+        from das_diff_veh_tpu.serve import FnComputeFactory, ServingEngine
+        from das_diff_veh_tpu.serve.metrics import _percentile
+
+        n_reqs = int(os.environ.get("BENCH_SERVE_REQS", 24))
+        n_shapes = max(1, int(os.environ.get("BENCH_SERVE_SHAPES", 4)))
+        inter_ms = float(os.environ.get("BENCH_SERVE_INTERARRIVAL_MS", 100.0))
+        s_nch = int(os.environ.get("BENCH_SERVE_NCH", 96))
+        s_nt = int(os.environ.get("BENCH_SERVE_NT", 4096))
+        s_fs = 250.0
+        s_pcfg = _PC()
+        s_dcfg = _DC()
+        s_freqs = jnp.asarray(freqs)
+        s_vels = jnp.asarray(vels)
+        nx_img = min(64, s_nch)
+
+        def serve_body(data):
+            d = preprocess_for_surface_waves(data, 1.0 / s_fs,
+                                             s_pcfg.sw_preprocess,
+                                             normalize=True)
+            return fv_map_fk(d[:nx_img], s_pcfg.interrogator.dx, 1.0 / s_fs,
+                             s_freqs, s_vels, norm=s_dcfg.norm,
+                             sg_window=s_dcfg.sg_window,
+                             sg_order=s_dcfg.sg_order)
+
+        serve_jit = jax.jit(serve_body)
+
+        def serve_build(bucket):
+            def fn(section, valid, state):
+                img = serve_jit(jnp.asarray(section.data))
+                return np.asarray(jax.block_until_ready(img)), state
+            return fn
+
+        rng_s = np.random.default_rng(42)
+        shapes = [(s_nch, s_nt - 128 * k) for k in range(n_shapes)]
+        reqs = [DasSection(
+                    rng_s.standard_normal(shapes[i % n_shapes],
+                                          ).astype(np.float32),
+                    np.arange(s_nch) * s_pcfg.interrogator.dx,
+                    np.arange(shapes[i % n_shapes][1]) / s_fs)
+                for i in range(n_reqs)]
+        arrivals = np.arange(n_reqs) * inter_ms / 1e3
+
+        def run_naive():
+            lat = []
+            t_start = time.perf_counter()
+            for i, sec in enumerate(reqs):
+                wait = arrivals[i] - (time.perf_counter() - t_start)
+                if wait > 0:
+                    time.sleep(wait)
+                np.asarray(jax.block_until_ready(
+                    serve_jit(jnp.asarray(sec.data))))
+                lat.append((time.perf_counter() - t_start - arrivals[i]) * 1e3)
+            wall = time.perf_counter() - t_start
+            return lat, n_reqs / wall
+
+        def run_engine():
+            eng = ServingEngine(
+                FnComputeFactory(serve_build, "bench_serve"),
+                ServeConfig(buckets=((s_nch, s_nt),), max_batch=4,
+                            max_queue=max(n_reqs, 8), batch_window_ms=2.0,
+                            default_deadline_ms=600000.0)).start()
+            futures = []
+            t_start = time.perf_counter()
+            for i, sec in enumerate(reqs):
+                wait = arrivals[i] - (time.perf_counter() - t_start)
+                if wait > 0:
+                    time.sleep(wait)
+                futures.append(eng.submit(sec))
+            for f in futures:
+                f.result()
+            wall = time.perf_counter() - t_start
+            snap = eng.metrics()            # ring has per-request latencies
+            eng.close()
+            return snap, n_reqs / wall
+
+        # naive first (its first-shape warmup = the nominal-shape deployment)
+        np.asarray(jax.block_until_ready(
+            serve_jit(jnp.asarray(reqs[0].data))))
+        naive_lat, naive_rps = run_naive()
+        snap, engine_rps = run_engine()
+        assert snap["cache_misses"] == 0, \
+            "engine recompiled in steady state (bucketed warmup broken)"
+        naive_sorted = sorted(naive_lat)
+        pct = _percentile          # same nearest-rank as the engine metrics
+
+        extra["serve_requests"] = n_reqs
+        extra["serve_shape_variants"] = n_shapes
+        extra["serve_interarrival_ms"] = inter_ms
+        extra["serve_naive_p50_ms"] = round(pct(naive_sorted, 0.50), 2)
+        extra["serve_naive_p99_ms"] = round(pct(naive_sorted, 0.99), 2)
+        extra["serve_naive_req_per_s"] = round(naive_rps, 3)
+        extra["serve_engine_p50_ms"] = snap["latency_ms"]["p50"]
+        extra["serve_engine_p99_ms"] = snap["latency_ms"]["p99"]
+        extra["serve_engine_req_per_s"] = round(engine_rps, 3)
+        extra["serve_engine_cache_misses"] = snap["cache_misses"]
+        extra["serve_engine_mean_batch_occupancy"] = \
+            snap["batch"]["mean_occupancy"]
+        extra["serve_p99_speedup"] = round(
+            pct(naive_sorted, 0.99) / max(snap["latency_ms"]["p99"], 1e-9), 2)
 
     # --- Pallas all-pairs kernel (BASELINE config 4) --------------------------
     # TPU backends only (the kernel uses pltpu memory spaces); "axon" is the

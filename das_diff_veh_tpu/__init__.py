@@ -22,5 +22,5 @@ testing and speedup measurement.
 
 __version__ = "0.1.0"
 
-from das_diff_veh_tpu.core.section import DasSection  # noqa: F401
 from das_diff_veh_tpu import config  # noqa: F401
+from das_diff_veh_tpu.core.section import DasSection  # noqa: F401

@@ -2,14 +2,18 @@
 uncertainty, and class summaries — the library form of the reference's
 imaging_diff_* / inversion_diff_* notebook logic."""
 
-from das_diff_veh_tpu.analysis.classify import (  # noqa: F401
-    classify_by_speed, classify_by_weight, majority_speed_mask,
-    majority_weight_mask, quasi_static_peaks, vehicle_speeds)
-from das_diff_veh_tpu.analysis.class_profiles import (  # noqa: F401
-    class_psd, class_timeseries_stats, quasi_static_signatures)
-from das_diff_veh_tpu.analysis.classed import (  # noqa: F401
-    ClassedAnalysis, class_stacks, classed_analysis)
-from das_diff_veh_tpu.analysis.ridge import (  # noqa: F401
-    extract_ridge, extract_ridge_batch)
-from das_diff_veh_tpu.analysis.bootstrap import (  # noqa: F401
-    bootstrap_disp, convergence_test, sample_indices)
+from das_diff_veh_tpu.analysis.bootstrap import (bootstrap_disp,
+                                                 convergence_test,
+                                                 sample_indices)
+from das_diff_veh_tpu.analysis.class_profiles import (class_psd,
+                                                      class_timeseries_stats,
+                                                      quasi_static_signatures)
+from das_diff_veh_tpu.analysis.classed import (ClassedAnalysis, class_stacks,
+                                               classed_analysis)
+from das_diff_veh_tpu.analysis.classify import (classify_by_speed,
+                                                classify_by_weight,
+                                                majority_speed_mask,
+                                                majority_weight_mask,
+                                                quasi_static_peaks,
+                                                vehicle_speeds)
+from das_diff_veh_tpu.analysis.ridge import extract_ridge, extract_ridge_batch

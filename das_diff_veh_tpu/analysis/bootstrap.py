@@ -20,12 +20,12 @@ from __future__ import annotations
 from functools import partial
 from typing import List, Optional, Sequence
 
-import numpy as np
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-from das_diff_veh_tpu.config import BootstrapConfig, DispersionConfig
 from das_diff_veh_tpu.analysis.ridge import extract_ridge_batch
+from das_diff_veh_tpu.config import BootstrapConfig, DispersionConfig
 from das_diff_veh_tpu.models.vsg import gather_disp_image
 
 

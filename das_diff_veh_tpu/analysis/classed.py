@@ -16,8 +16,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, Mapping, Tuple
 
-import numpy as np
 import jax.numpy as jnp
+import numpy as np
 
 from das_diff_veh_tpu.analysis import classify as C
 from das_diff_veh_tpu.analysis.class_profiles import (class_psd,

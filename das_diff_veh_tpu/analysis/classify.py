@@ -7,9 +7,9 @@ cells 5-8 (1.2 / histogram-mode weight thresholds).
 
 from __future__ import annotations
 
-import numpy as np
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from das_diff_veh_tpu.core.section import VehicleTracks, WindowBatch
 

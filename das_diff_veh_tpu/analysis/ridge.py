@@ -27,9 +27,9 @@ from __future__ import annotations
 from functools import partial
 from typing import Callable, Optional
 
-import numpy as np
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from das_diff_veh_tpu.ops.savgol import savgol_filter
 

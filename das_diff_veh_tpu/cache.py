@@ -3,7 +3,11 @@
 This host has one slow CPU core; XLA backend compiles of the larger graphs
 take minutes, dominating cold test/benchmark runs.  Every entry point
 (tests/conftest.py, bench.py, scripts/*) enables the same repo-local cache
-through this helper so reruns skip compilation entirely.
+through this helper so reruns skip compilation entirely.  The batch CLI and
+the serving engine expose ``cache_dir`` as a user knob
+(``--compilation_cache_dir`` / ``ServeConfig.compilation_cache_dir``) so
+deployments point it at a durable path and warmups stay cheap across
+process restarts.
 """
 
 from __future__ import annotations
@@ -11,12 +15,17 @@ from __future__ import annotations
 import os
 
 
-def enable_compilation_cache(repo_root: str | None = None) -> None:
+def enable_compilation_cache(repo_root: str | None = None,
+                             cache_dir: str | None = None) -> None:
+    """Point JAX's persistent compilation cache at ``cache_dir`` (explicit
+    deployment path) or ``<repo_root>/.jax_cache`` (the repo-local default
+    used by tests and benches)."""
     import jax
 
-    if repo_root is None:
-        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(repo_root, ".jax_cache"))
+    if cache_dir is None:
+        if repo_root is None:
+            repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        cache_dir = os.path.join(repo_root, ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

@@ -202,6 +202,57 @@ class BootstrapConfig:
 
 
 @dataclass(frozen=True)
+class ServeConfig:
+    """Online serving engine knobs (``das_diff_veh_tpu.serve``).
+
+    Like :class:`RuntimeConfig` (runtime/config.py) these are execution
+    knobs, not physics: none of them changes a single output bit for a
+    request that is admitted.  ``buckets`` is the one exception in spirit —
+    it decides how much zero-padding a request's ``(n_ch, nt)`` receives
+    before hitting the compiled program, so bucket choice belongs next to
+    the numerical config it serves (see docs/USAGE.md §serving for bucket
+    selection guidance).
+    """
+
+    buckets: Tuple[Tuple[int, int], ...] = ()
+    """Allowed padded request shapes, ``(n_ch, nt)`` each.  A request is
+    padded up to the smallest bucket that fits it (area-wise smallest
+    first); a request no bucket fits is rejected at submit.  Empty means
+    the engine cannot admit anything — always configure this."""
+
+    max_batch: int = 4
+    """Microbatch size cap: the dispatcher executes at most this many
+    same-bucket requests per compiled-program visit."""
+
+    max_queue: int = 64
+    """Admission-queue bound (backpressure): ``submit`` raises
+    ``QueueFullError`` once this many requests are waiting."""
+
+    batch_window_ms: float = 2.0
+    """How long the dispatcher lingers for same-bucket companions after
+    picking a batch head before executing a partial microbatch."""
+
+    default_deadline_ms: float = 30000.0
+    """Deadline applied to requests that do not pass one.  A request still
+    queued past its deadline is shed (``DeadlineExceededError``), counted
+    separately from backpressure rejections."""
+
+    warmup: bool = True
+    """Ahead-of-time compile every configured bucket at ``start()`` so
+    steady-state requests never pay a trace (the compiled-cache miss
+    counter stays at zero for in-bucket traffic)."""
+
+    latency_window: int = 1024
+    """Completed-request latencies kept for the p50/p95/p99 snapshot."""
+
+    compilation_cache_dir: Optional[str] = None
+    """Persistent XLA compilation cache directory
+    (``jax_compilation_cache_dir``) applied at engine start, so warmups are
+    near-free across process restarts.  None leaves the process setting
+    untouched."""
+
+
+@dataclass(frozen=True)
 class PipelineConfig:
     """Everything, bundled. Static under jit."""
 

@@ -12,13 +12,12 @@ from das_diff_veh_tpu.inversion.curves import (Curve, curves_from_ridges,
 from das_diff_veh_tpu.inversion.forward import (LayeredModel,
                                                 density_gardner_linear,
                                                 phase_velocity,
-                                                scan_mode_diagnostics,
                                                 rayleigh_halfspace_velocity,
-                                                secular, vp_from_poisson)
+                                                scan_mode_diagnostics, secular,
+                                                vp_from_poisson)
 from das_diff_veh_tpu.inversion.invert import (InversionResult, LayerBounds,
                                                ModelSpec, invert,
-                                               invert_multirun,
-                                               make_misfit_fn,
+                                               invert_multirun, make_misfit_fn,
                                                speed_model_spec,
                                                weight_model_spec)
 from das_diff_veh_tpu.inversion.sensitivity import (SensitivityKernel,

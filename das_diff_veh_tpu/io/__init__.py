@@ -1,7 +1,3 @@
-from das_diff_veh_tpu.io.readers import (  # noqa: F401
-    read_npz_section,
-    read_segy_section,
-    read_sections,
-    DirectoryDataset,
-)
-from das_diff_veh_tpu.io.synthetic import SceneConfig, synthesize_section  # noqa: F401
+from das_diff_veh_tpu.io.readers import (DirectoryDataset, read_npz_section,
+                                         read_sections, read_segy_section)
+from das_diff_veh_tpu.io.synthetic import SceneConfig, synthesize_section

@@ -18,11 +18,11 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
-import numpy as np
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-from das_diff_veh_tpu.config import TrackQCConfig, TrackingConfig
+from das_diff_veh_tpu.config import TrackingConfig, TrackQCConfig
 from das_diff_veh_tpu.core.section import VehicleTracks
 from das_diff_veh_tpu.ops.interp import masked_interp_clamped
 from das_diff_veh_tpu.ops.peaks import find_peaks, gaussian_likelihood

@@ -23,15 +23,15 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-import numpy as np
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from das_diff_veh_tpu.config import DispersionConfig, GatherConfig
 from das_diff_veh_tpu.core.section import WindowBatch
-from das_diff_veh_tpu.ops.interp import masked_interp
 from das_diff_veh_tpu.ops import xcorr as xc
 from das_diff_veh_tpu.ops.dispersion import fv_map_fk, fv_map_phase_shift
+from das_diff_veh_tpu.ops.interp import masked_interp
 
 
 @dataclass(frozen=True)

@@ -12,9 +12,9 @@ instead of the reference's per-time-sample Python loop
 
 from __future__ import annotations
 
-import numpy as np
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from das_diff_veh_tpu.config import MuteConfig, WindowConfig
 from das_diff_veh_tpu.core.section import VehicleTracks, WindowBatch

@@ -1,18 +1,10 @@
-from das_diff_veh_tpu.ops.filters import (  # noqa: F401
-    bandpass_time,
-    bandpass_space,
-    tukey_window,
-    taper_time,
-    detrend_linear,
-    remove_common_mode,
-    das_preprocess,
-)
-from das_diff_veh_tpu.ops.savgol import savgol_filter  # noqa: F401
-from das_diff_veh_tpu.ops.resample import resample_poly  # noqa: F401
-from das_diff_veh_tpu.ops.psd import welch_psd  # noqa: F401
-from das_diff_veh_tpu.ops.cwt import cwt_morlet, pick_travel_times  # noqa: F401
-from das_diff_veh_tpu.ops.qc import (  # noqa: F401
-    noisy_trace_mask,
-    empty_trace_mask,
-    impute_traces,
-)
+from das_diff_veh_tpu.ops.cwt import cwt_morlet, pick_travel_times
+from das_diff_veh_tpu.ops.filters import (bandpass_space, bandpass_time,
+                                          das_preprocess, detrend_linear,
+                                          remove_common_mode, taper_time,
+                                          tukey_window)
+from das_diff_veh_tpu.ops.psd import welch_psd
+from das_diff_veh_tpu.ops.qc import (empty_trace_mask, impute_traces,
+                                     noisy_trace_mask)
+from das_diff_veh_tpu.ops.resample import resample_poly
+from das_diff_veh_tpu.ops.savgol import savgol_filter

@@ -11,7 +11,7 @@ from __future__ import annotations
 import numpy as np
 from scipy.signal import find_peaks
 
-from das_diff_veh_tpu.config import TrackQCConfig, TrackingConfig
+from das_diff_veh_tpu.config import TrackingConfig, TrackQCConfig
 
 
 def ref_likelihood(peak_loc: np.ndarray, t_axis: np.ndarray, sigma: float) -> np.ndarray:

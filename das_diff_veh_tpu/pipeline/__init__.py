@@ -8,6 +8,7 @@ pipelined runtime (``das_diff_veh_tpu.runtime``): prefetch, per-chunk fault
 isolation, manifest-driven exact resume, and Chrome-trace span output.
 """
 
-from das_diff_veh_tpu.pipeline.preprocess import (  # noqa: F401
-    preprocess_for_surface_waves, preprocess_for_tracking, channels_to_distance)
-from das_diff_veh_tpu.pipeline.timelapse import ChunkResult, process_chunk  # noqa: F401
+from das_diff_veh_tpu.pipeline.preprocess import (channels_to_distance,
+                                                  preprocess_for_surface_waves,
+                                                  preprocess_for_tracking)
+from das_diff_veh_tpu.pipeline.timelapse import ChunkResult, process_chunk

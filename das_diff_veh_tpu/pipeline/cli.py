@@ -1,8 +1,14 @@
-"""Command-line batch imaging (reference apis/imaging_workflow.py:206-223).
+"""Command-line entry: batch imaging, plus the ``serve`` subcommand.
 
     python -m das_diff_veh_tpu.pipeline.cli --data_root /data \
         --start_date 20230301 --end_date 20230307 --x0 700 --method xcorr \
         --prefetch_depth 3 --trace results/run_trace.jsonl
+
+    python -m das_diff_veh_tpu.pipeline.cli serve \
+        --buckets 140x30000,140x15000 --x0 700 --port 8080
+
+The batch flags stay top-level (stable since PR 2); ``serve`` routes to
+:mod:`das_diff_veh_tpu.serve.cli`.
 """
 
 from __future__ import annotations
@@ -43,14 +49,27 @@ def build_parser() -> argparse.ArgumentParser:
     rt.add_argument("--trace", default=None, metavar="PATH",
                     help="write Chrome-trace JSONL spans to PATH "
                          "(open in chrome://tracing or Perfetto)")
+    rt.add_argument("--compilation_cache_dir", default=None, metavar="DIR",
+                    help="persistent XLA compilation cache "
+                         "(jax_compilation_cache_dir): reruns and serve "
+                         "warmups skip recompiles across process restarts")
     return p
 
 
 def main(argv=None) -> int:
+    import sys
+
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "serve":
+        from das_diff_veh_tpu.serve.cli import serve_main
+        return serve_main(argv[1:])
     parser = build_parser()
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO if args.verbal else logging.WARNING,
                         format="%(asctime)s %(name)s %(message)s")
+    if args.compilation_cache_dir:
+        from das_diff_veh_tpu.cache import enable_compilation_cache
+        enable_compilation_cache(cache_dir=args.compilation_cache_dir)
     if args.figures:
         from das_diff_veh_tpu.viz import figure_set_from_synthetic
         for f in figure_set_from_synthetic(args.out_dir):

@@ -19,15 +19,16 @@ from __future__ import annotations
 
 from fractions import Fraction
 
-import numpy as np
 import jax.numpy as jnp
+import numpy as np
 
 from das_diff_veh_tpu.config import (InterrogatorConfig,
                                      SurfaceWavePreprocessConfig,
                                      TrackingPreprocessConfig)
 from das_diff_veh_tpu.ops.filters import (bandpass_space, bandpass_time,
                                           l2_normalize_traces)
-from das_diff_veh_tpu.ops.qc import empty_trace_mask, impute_traces, noisy_trace_mask
+from das_diff_veh_tpu.ops.qc import (empty_trace_mask, impute_traces,
+                                     noisy_trace_mask)
 from das_diff_veh_tpu.ops.resample import resample_poly
 
 

@@ -11,12 +11,13 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
-import numpy as np
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from das_diff_veh_tpu.config import PipelineConfig
-from das_diff_veh_tpu.core.section import DasSection, VehicleTracks, WindowBatch
+from das_diff_veh_tpu.core.section import (DasSection, VehicleTracks,
+                                           WindowBatch)
 from das_diff_veh_tpu.models import vsg as V
 from das_diff_veh_tpu.models.tracking import track_section
 from das_diff_veh_tpu.models.windows import select_windows, traj_mute_mask

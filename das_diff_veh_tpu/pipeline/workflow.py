@@ -25,11 +25,10 @@ import time
 from dataclasses import dataclass, field
 from datetime import datetime, timedelta
 from typing import List, Optional
-
 from zipfile import BadZipFile as zipfile_BadZipFile
 
-import numpy as np
 import jax
+import numpy as np
 
 from das_diff_veh_tpu.config import PipelineConfig
 from das_diff_veh_tpu.core.section import DasSection

@@ -33,6 +33,13 @@ class NullTracer:
     def span(self, name: str, cat: str = "runtime", **args) -> Iterator[None]:
         yield
 
+    def now_us(self) -> float:
+        return 0.0
+
+    def complete(self, name: str, start_us: float, cat: str = "runtime",
+                 **args) -> None:
+        pass
+
     def counter(self, name: str, **values) -> None:
         pass
 
@@ -76,6 +83,22 @@ class TraceWriter(NullTracer):
                 self._f.flush()
 
     # -- public API ----------------------------------------------------------
+    def now_us(self) -> float:
+        """Current trace-clock timestamp; pair with :meth:`complete` for
+        spans whose start and end happen on different threads (the serving
+        engine's queue-wait span starts in ``submit`` and ends in the
+        dispatcher)."""
+        return self._now_us()
+
+    def complete(self, name: str, start_us: float, cat: str = "runtime",
+                 **args) -> None:
+        """Emit one complete ("X") event from an explicit start timestamp
+        (a value previously returned by :meth:`now_us`) to now."""
+        self._emit({"name": name, "cat": cat, "ph": "X",
+                    "ts": round(start_us, 1),
+                    "dur": round(max(self._now_us() - start_us, 0.0), 1),
+                    "pid": 1, "tid": self._tid(), "args": args})
+
     @contextmanager
     def span(self, name: str, cat: str = "runtime", **args) -> Iterator[None]:
         """Emit one complete ("X") event covering the with-block."""

@@ -1,0 +1,45 @@
+"""Online serving engine: microbatched, shape-bucketed, deadline-aware.
+
+The batch side of this repo (``runtime``/``pipeline.workflow``) walks
+directories of dates; this package is the other entry point the ROADMAP's
+"serves heavy traffic" north star needs — hand the system ONE DAS segment,
+get a dispersion image back with bounded latency.  Seven concerns, one
+module each:
+
+- :mod:`buckets` — pad ``(n_ch, nt)`` onto a small configurable shape set;
+- :mod:`compile_cache` — compiled programs keyed ``(bucket, config_hash)``,
+  AOT-warmable so steady-state requests never pay a trace;
+- :mod:`engine` — bounded admission queue, deadline shedding, a dispatcher
+  thread forming same-bucket microbatches, per-request span accounting;
+- :mod:`metrics` — p50/p95/p99 latency, queue depth, occupancy, shed and
+  cache counters as one snapshot dict;
+- :mod:`session` — streaming per-fiber state across consecutive segments;
+- :mod:`imaging` — the production ``process_chunk`` compute factory;
+- :mod:`http` / :mod:`cli` — stdlib JSON endpoint + ``serve`` subcommand.
+"""
+
+from das_diff_veh_tpu.config import ServeConfig
+from das_diff_veh_tpu.serve.buckets import (normalize_buckets, pad_section,
+                                            pick_bucket, unpad)
+from das_diff_veh_tpu.serve.compile_cache import (CompiledFunctionCache,
+                                                  ComputeFactory,
+                                                  FnComputeFactory)
+from das_diff_veh_tpu.serve.engine import (DeadlineExceededError,
+                                           EngineClosedError,
+                                           InvalidRequestError, NoBucketError,
+                                           QueueFullError, ServingEngine,
+                                           ShedError)
+from das_diff_veh_tpu.serve.http import make_server, serve_in_thread
+from das_diff_veh_tpu.serve.imaging import ImagingComputeFactory, ImagingResult
+from das_diff_veh_tpu.serve.metrics import ServeMetrics
+from das_diff_veh_tpu.serve.session import SessionStore
+
+__all__ = [
+    "ServeConfig", "ServingEngine", "ComputeFactory", "FnComputeFactory",
+    "CompiledFunctionCache", "ImagingComputeFactory", "ImagingResult",
+    "ServeMetrics", "SessionStore", "ShedError", "QueueFullError",
+    "DeadlineExceededError", "NoBucketError", "InvalidRequestError",
+    "EngineClosedError",
+    "normalize_buckets", "pick_bucket", "pad_section", "unpad",
+    "make_server", "serve_in_thread",
+]

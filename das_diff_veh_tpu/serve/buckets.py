@@ -1,0 +1,89 @@
+"""Shape buckets: pad a request's ``(n_ch, nt)`` onto a small fixed set.
+
+``process_chunk`` traces and compiles per input shape (~40 s/call on CPU
+for a fresh shape), so an online engine that forwarded raw request shapes
+would pay a compile on every novel ``(n_ch, nt)``.  Instead every admitted
+request is zero-padded up to the smallest configured bucket that fits it;
+the compiled-function cache is keyed on the bucket, and the set of programs
+the engine can ever run is fixed (and warmable) at startup.
+
+Padding is pure host-side NumPy: data gets trailing zeros, the ``x`` and
+``t`` axes are extended by continuing their own spacing (so ``dx``/``dt``
+derived by downstream code is unchanged).  ``valid`` — the request's true
+extents — travels with the padded section; compute functions use it to
+mask or slice so the engine round trip (pad -> compute -> unpad) is exactly
+the unpadded computation (asserted in tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from das_diff_veh_tpu.core.section import DasSection
+
+Bucket = Tuple[int, int]            # (n_ch, nt), the padded shape
+
+
+def normalize_buckets(buckets: Sequence[Sequence[int]]) -> Tuple[Bucket, ...]:
+    """Validate + sort buckets smallest-area-first (the selection order)."""
+    out = []
+    for b in buckets:
+        n_ch, nt = int(b[0]), int(b[1])
+        if n_ch <= 0 or nt <= 0:
+            raise ValueError(f"bucket shape must be positive, got {(n_ch, nt)}")
+        out.append((n_ch, nt))
+    out.sort(key=lambda b: (b[0] * b[1], b))
+    return tuple(out)
+
+
+def pick_bucket(shape: Tuple[int, int],
+                buckets: Sequence[Bucket]) -> Optional[Bucket]:
+    """Smallest-area bucket that fits ``shape`` in both dims; None if none
+    does (the engine rejects such requests at submit).  One O(n) scan, no
+    normalization — this sits on the submit hot path; validation happens
+    once at engine construction via :func:`normalize_buckets`."""
+    n_ch, nt = shape
+    best = None
+    for b in buckets:
+        bc, bn = int(b[0]), int(b[1])
+        if bc >= n_ch and bn >= nt:
+            key = (bc * bn, (bc, bn))
+            if best is None or key < best[0]:
+                best = (key, (bc, bn))
+    return best[1] if best is not None else None
+
+
+def pad_section(section: DasSection, bucket: Bucket) -> DasSection:
+    """Zero-pad ``section`` up to ``bucket``, extending axes by their own
+    spacing.  A section already at the bucket shape is returned untouched
+    (same arrays — the exact-shape fast path pads nothing)."""
+    data = np.asarray(section.data)
+    n_ch, nt = data.shape
+    b_ch, b_nt = bucket
+    if n_ch > b_ch or nt > b_nt:
+        raise ValueError(f"section {data.shape} does not fit bucket {bucket}")
+    if (n_ch, nt) == (b_ch, b_nt):
+        return section
+    x = np.asarray(section.x)
+    t = np.asarray(section.t)
+    padded = np.zeros((b_ch, b_nt), dtype=data.dtype)
+    padded[:n_ch, :nt] = data
+    return DasSection(padded, _extend_axis(x, b_ch), _extend_axis(t, b_nt))
+
+
+def unpad(array: np.ndarray, valid: Tuple[int, int]) -> np.ndarray:
+    """Slice a bucket-shaped per-sample array back to the request's true
+    extents (identity for outputs whose shape does not follow the input,
+    e.g. the fixed-grid dispersion image — callers only unpad arrays whose
+    leading dims match the bucket)."""
+    return np.asarray(array)[:valid[0], :valid[1]]
+
+
+def _extend_axis(axis: np.ndarray, n: int) -> np.ndarray:
+    if axis.size >= n:
+        return axis
+    step = float(axis[1] - axis[0]) if axis.size > 1 else 1.0
+    extra = axis[-1] + step * np.arange(1, n - axis.size + 1, dtype=axis.dtype)
+    return np.concatenate([axis, extra.astype(axis.dtype)])

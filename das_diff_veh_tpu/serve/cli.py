@@ -1,0 +1,96 @@
+"""``serve`` subcommand: stand up the online engine behind the HTTP front.
+
+    python -m das_diff_veh_tpu.pipeline.cli serve \
+        --buckets 140x30000,140x15000 --x0 700 --method xcorr \
+        --port 8080 --compilation_cache_dir /var/cache/das_jax
+
+Warms every bucket at startup (AOT — steady-state requests never trace),
+then serves until interrupted; the metrics snapshot prints on exit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+
+from das_diff_veh_tpu.config import ImagingConfig, PipelineConfig, ServeConfig
+from das_diff_veh_tpu.runtime.tracing import make_tracer
+from das_diff_veh_tpu.serve.engine import ServingEngine
+from das_diff_veh_tpu.serve.http import make_server
+from das_diff_veh_tpu.serve.imaging import ImagingComputeFactory
+
+
+def parse_buckets(spec: str):
+    """``"140x30000,100x15000"`` -> ((140, 30000), (100, 15000))."""
+    try:
+        return tuple(tuple(int(v) for v in part.split("x"))
+                     for part in spec.split(",") if part)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(
+            f"buckets must look like 140x30000,100x15000 (got {spec!r})") from e
+
+
+def build_serve_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="das_diff_veh_tpu serve",
+        description="Online DAS-segment serving engine (HTTP JSON front)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080,
+                   help="0 picks an ephemeral port (printed at startup)")
+    p.add_argument("--buckets", type=parse_buckets, required=True,
+                   metavar="CHxNT[,CHxNT...]",
+                   help="padded request shapes, e.g. 140x30000,140x15000")
+    p.add_argument("--x0", type=float, default=700.0, help="pivot along fiber [m]")
+    p.add_argument("--method", default="xcorr",
+                   choices=["xcorr", "surface_wave"])
+    p.add_argument("--x_is_channels", action="store_true",
+                   help="request x axes carry channel numbers, not meters")
+    p.add_argument("--fs", type=float, default=250.0,
+                   help="sampling rate the warmup time axis assumes [Hz]")
+    p.add_argument("--max_batch", type=int, default=4)
+    p.add_argument("--max_queue", type=int, default=64)
+    p.add_argument("--batch_window_ms", type=float, default=2.0)
+    p.add_argument("--deadline_ms", type=float, default=30000.0,
+                   help="default per-request deadline")
+    p.add_argument("--no_warmup", action="store_true",
+                   help="skip AOT bucket warmup (first requests pay traces)")
+    p.add_argument("--compilation_cache_dir", default=None, metavar="DIR",
+                   help="persistent XLA compilation cache "
+                        "(jax_compilation_cache_dir) — makes warmup near-free "
+                        "across restarts")
+    p.add_argument("--trace", default=None, metavar="PATH",
+                   help="write Chrome-trace JSONL request spans to PATH")
+    p.add_argument("--verbal", action="store_true", help="info-level logs")
+    return p
+
+
+def serve_main(argv=None) -> int:
+    args = build_serve_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO if args.verbal else logging.WARNING,
+                        format="%(asctime)s %(name)s %(message)s")
+    cfg = PipelineConfig().replace(imaging=ImagingConfig(x0=args.x0))
+    serve_cfg = ServeConfig(
+        buckets=args.buckets, max_batch=args.max_batch,
+        max_queue=args.max_queue, batch_window_ms=args.batch_window_ms,
+        default_deadline_ms=args.deadline_ms, warmup=not args.no_warmup,
+        compilation_cache_dir=args.compilation_cache_dir)
+    tracer = make_tracer(args.trace)
+    factory = ImagingComputeFactory(cfg, method=args.method,
+                                    x_is_channels=args.x_is_channels,
+                                    fs=args.fs)
+    engine = ServingEngine(factory, serve_cfg, tracer=tracer)
+    engine.start()
+    server = make_server(engine, args.host, args.port)
+    print(f"serving on http://{server.server_address[0]}"
+          f":{server.server_address[1]} buckets={list(args.buckets)}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+        engine.close()
+        tracer.close()
+        print(json.dumps(engine.metrics(), indent=1))
+    return 0

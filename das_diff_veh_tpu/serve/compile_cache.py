@@ -1,0 +1,128 @@
+"""Compiled-function cache keyed on ``(bucket_shape, config_hash)``.
+
+The engine never calls a compute function directly: it asks this cache for
+the program bound to a request's bucket.  A program is built once per
+``(bucket, config_key)`` by the engine's :class:`ComputeFactory` and then
+reused for every request padded to that bucket — with AOT warmup at engine
+start, steady-state traffic confined to the configured buckets performs
+zero new compilations (the ``cache_misses`` counter stays at zero; warmup
+builds are counted separately as ``warmup_builds``).
+
+The build itself is what triggers JAX tracing/compilation for the real
+imaging path: ``warmup`` runs the fresh program once on the factory's
+representative section, so XLA compiles ahead of the first real request
+(and lands in the persistent compilation cache when one is configured).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from das_diff_veh_tpu.core.section import DasSection
+from das_diff_veh_tpu.serve.buckets import Bucket
+
+log = logging.getLogger("das_diff_veh_tpu.serve")
+
+# (padded_section, valid (n_ch, nt), state_in) -> (result, state_out)
+ComputeFn = Callable[[DasSection, Tuple[int, int], Any], Tuple[Any, Any]]
+
+
+class ComputeFactory:
+    """Builds one compute function per bucket; subclass or wrap a closure.
+
+    ``config_key`` is hashed into the cache key: two engines serving
+    different numerical configs never share programs.  ``warmup_section``
+    must return an input *representative of real traffic* for the bucket —
+    for the imaging pipeline that means the deployment's actual fiber axis,
+    because host-side geometry (``x`` values) selects static slice bounds
+    and therefore the compiled program (see serve/imaging.py).
+    """
+
+    config_key: str = ""
+
+    def build(self, bucket: Bucket) -> ComputeFn:
+        raise NotImplementedError
+
+    def validate(self, section: DasSection,
+                 bucket: Bucket) -> Optional[str]:
+        """Admission-time check, called by ``ServingEngine.submit`` after
+        bucket selection: return a human-readable rejection reason for a
+        request this factory could never serve (shed up front as
+        ``InvalidRequestError`` instead of failing later on the dispatcher),
+        or None to admit.  Default: everything is servable."""
+        return None
+
+    def warmup_section(self, bucket: Bucket) -> DasSection:
+        import numpy as np
+        n_ch, nt = bucket
+        return DasSection(np.zeros(bucket, dtype=np.float32),
+                          np.arange(n_ch, dtype=np.float64),
+                          np.arange(nt, dtype=np.float64))
+
+
+class FnComputeFactory(ComputeFactory):
+    """Adapter: a plain ``bucket -> ComputeFn`` builder plus a key."""
+
+    def __init__(self, build_fn: Callable[[Bucket], ComputeFn],
+                 config_key: str = "",
+                 warmup_section_fn: Optional[Callable[[Bucket], DasSection]] = None):
+        self._build_fn = build_fn
+        self.config_key = config_key
+        self._warmup_section_fn = warmup_section_fn
+
+    def build(self, bucket: Bucket) -> ComputeFn:
+        return self._build_fn(bucket)
+
+    def warmup_section(self, bucket: Bucket) -> DasSection:
+        if self._warmup_section_fn is not None:
+            return self._warmup_section_fn(bucket)
+        return super().warmup_section(bucket)
+
+
+class CompiledFunctionCache:
+    """Maps ``(bucket, config_key)`` to a built compute function."""
+
+    def __init__(self, factory: ComputeFactory, metrics):
+        self._factory = factory
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._programs: Dict[Tuple[Bucket, str], ComputeFn] = {}
+
+    def _key(self, bucket: Bucket) -> Tuple[Bucket, str]:
+        return (bucket, self._factory.config_key)
+
+    def warmup(self, bucket: Bucket) -> None:
+        """Build the bucket's program and execute it once on the factory's
+        representative section, so tracing AND the XLA compile happen now."""
+        key = self._key(bucket)
+        with self._lock:
+            if key in self._programs:
+                return
+            program = self._factory.build(bucket)
+            self._programs[key] = program
+        self._metrics.inc("warmup_builds")
+        section = self._factory.warmup_section(bucket)
+        program(section, bucket, None)
+        log.info("warmed bucket %s", bucket)
+
+    def get(self, bucket: Bucket) -> ComputeFn:
+        """Program for ``bucket``; builds on miss (counted — steady-state
+        in-bucket traffic after warmup never misses)."""
+        key = self._key(bucket)
+        with self._lock:
+            program = self._programs.get(key)
+            if program is not None:
+                self._metrics.inc("cache_hits")
+                return program
+            program = self._factory.build(bucket)
+            self._programs[key] = program
+        self._metrics.inc("cache_misses")
+        log.info("compiled-cache miss: built bucket %s on demand", bucket)
+        return program
+
+    @property
+    def buckets(self):
+        with self._lock:
+            return sorted(b for b, _ in self._programs)

@@ -1,0 +1,330 @@
+"""In-process online serving engine: microbatched, bucketed, deadline-aware.
+
+Callers ``submit(section, deadline_ms=..., session=...)`` and get a
+``concurrent.futures.Future`` back; a single dispatcher thread drains the
+bounded admission queue, groups same-bucket requests into microbatches (up
+to ``ServeConfig.max_batch``, lingering ``batch_window_ms`` for
+companions), pads each request to its bucket, and executes the batch
+through the compiled-function cache.  Overload is shed, not absorbed:
+
+- **reject-on-full** — ``submit`` raises :class:`QueueFullError` once
+  ``max_queue`` requests wait (counted as ``shed_rejected``);
+- **expire-in-queue** — a request whose deadline passes before compute
+  starts fails with :class:`DeadlineExceededError` (``shed_expired``)
+  instead of wasting device time on an answer nobody is waiting for.
+
+Microbatch members execute *serially* through the bucket's one compiled
+program (``process_chunk`` is not vmappable across requests — host-side
+geometry staging picks static slice bounds per call): what batching buys
+is one program lookup and bucket switch per batch, back-to-back device
+dispatches, and coherent deadline checks — not vectorized compute.  The
+flip side is the ``batch_window_ms`` linger a lone request pays on an
+idle engine (default 2 ms, documented in docs/PERF.md).
+
+Every request is accounted in four spans — queue / pad / compute / unpad —
+emitted through :mod:`das_diff_veh_tpu.runtime.tracing` (the queue span
+starts in ``submit`` and closes on the dispatcher thread via
+``tracer.complete``) and aggregated by :class:`ServeMetrics`
+(p50/p95/p99 latency, queue depth, batch occupancy, shed + cache counters:
+``engine.metrics()``).  Consecutive segments of one fiber may share a
+``session``: the dispatcher threads the per-session state through the
+compute function in execution order (see serve/session.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+from das_diff_veh_tpu.config import ServeConfig
+from das_diff_veh_tpu.core.section import DasSection
+from das_diff_veh_tpu.runtime.tracing import NullTracer
+from das_diff_veh_tpu.serve.buckets import (Bucket, normalize_buckets,
+                                            pad_section, pick_bucket)
+from das_diff_veh_tpu.serve.compile_cache import (CompiledFunctionCache,
+                                                  ComputeFactory)
+from das_diff_veh_tpu.serve.metrics import ServeMetrics
+from das_diff_veh_tpu.serve.session import SessionStore
+
+log = logging.getLogger("das_diff_veh_tpu.serve")
+
+
+class ShedError(RuntimeError):
+    """Base class for load-shedding rejections."""
+
+
+class QueueFullError(ShedError):
+    """Admission queue at ``max_queue``: backpressure, try again later."""
+
+
+class DeadlineExceededError(ShedError):
+    """The request's deadline passed before compute started."""
+
+
+class NoBucketError(ShedError):
+    """No configured bucket fits the request's ``(n_ch, nt)``."""
+
+
+class InvalidRequestError(ShedError):
+    """The compute factory's admission check rejected the request (e.g.
+    geometry that does not match the warmed programs)."""
+
+
+class EngineClosedError(RuntimeError):
+    """submit() after close()."""
+
+
+@dataclass
+class _Request:
+    section: DasSection
+    valid: Tuple[int, int]
+    bucket: Bucket
+    deadline: float                    # absolute perf_counter seconds
+    session: Optional[str]
+    future: Future
+    t_submit: float                    # perf_counter seconds
+    t_submit_us: float                 # tracer clock (for the queue span)
+
+
+class ServingEngine:
+    """One engine = one numerical config + one bucket set + one dispatcher.
+
+    ``factory`` builds the per-bucket compute functions (see
+    serve/compile_cache.py for the contract; serve/imaging.py for the real
+    ``process_chunk`` factory).  Call :meth:`start` before submitting;
+    :meth:`close` drains in-flight requests and stops the dispatcher.
+    """
+
+    def __init__(self, factory: ComputeFactory,
+                 cfg: Optional[ServeConfig] = None, tracer=None):
+        self.cfg = cfg if cfg is not None else ServeConfig()
+        self.buckets = normalize_buckets(self.cfg.buckets)
+        self.factory = factory
+        self.tracer = tracer if tracer is not None else NullTracer()
+        self._metrics = ServeMetrics(latency_window=self.cfg.latency_window)
+        self.sessions = SessionStore()
+        self.cache = CompiledFunctionCache(factory, self._metrics)
+        self._queue: queue.Queue = queue.Queue(maxsize=self.cfg.max_queue)
+        self._stash: deque = deque()   # dequeued, deferred to a later batch
+        self._closed = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._metrics.bind_queue_depth(
+            lambda: self._queue.qsize() + len(self._stash))
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ServingEngine":
+        if self._closed.is_set():
+            raise EngineClosedError("engine was closed; build a new one")
+        if self._thread is not None:
+            return self
+        if self.cfg.compilation_cache_dir:
+            from das_diff_veh_tpu.cache import enable_compilation_cache
+            enable_compilation_cache(cache_dir=self.cfg.compilation_cache_dir)
+        if self.cfg.warmup:
+            with self.tracer.span("warmup", cat="serve",
+                                  buckets=list(map(list, self.buckets))):
+                for b in self.buckets:
+                    self.cache.warmup(b)
+        self._thread = threading.Thread(target=self._dispatch_loop,
+                                        name="serve-dispatch", daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop admitting, drain queued requests, join the dispatcher.
+
+        Requests already queued complete normally; anything that slips into
+        the queue after the dispatcher exits (the submit/close race) is
+        failed with :class:`EngineClosedError` rather than left hanging."""
+        self._closed.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                # still draining a long compute; it owns the queue until it
+                # exits, so leave pending futures to it
+                log.warning("dispatcher did not exit within %.1fs (compute "
+                            "still running); leaving it to finish", timeout)
+                return
+            self._thread = None
+        self._fail_pending(EngineClosedError("engine closed"))
+
+    def _fail_pending(self, exc: Exception) -> None:
+        while True:
+            req = self._next_request(timeout=0.0)
+            if req is None:
+                return
+            if not req.future.done():
+                req.future.set_exception(exc)
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, section: DasSection, deadline_ms: Optional[float] = None,
+               session: Optional[str] = None) -> Future:
+        """Enqueue one request; returns a Future resolving to the compute
+        result (or raising the shed/compute error).  Raises immediately on
+        backpressure (:class:`QueueFullError`) and unservable shapes
+        (:class:`NoBucketError`)."""
+        if self._closed.is_set():
+            raise EngineClosedError("engine is closed")
+        valid = tuple(int(s) for s in section.data.shape)
+        bucket = pick_bucket(valid, self.buckets)
+        if bucket is None:
+            self._metrics.inc("shed_no_bucket")
+            raise NoBucketError(
+                f"no bucket fits request shape {valid} "
+                f"(buckets: {list(self.buckets)})")
+        reason = self.factory.validate(section, bucket)
+        if reason is not None:
+            self._metrics.inc("shed_invalid")
+            raise InvalidRequestError(reason)
+        if deadline_ms is None:
+            deadline_ms = self.cfg.default_deadline_ms
+        now = time.perf_counter()
+        req = _Request(section=section, valid=valid, bucket=bucket,
+                       deadline=now + deadline_ms / 1e3, session=session,
+                       future=Future(), t_submit=now,
+                       t_submit_us=self.tracer.now_us())
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            self._metrics.inc("shed_rejected")
+            self.tracer.instant("shed", cat="serve", reason="queue_full")
+            raise QueueFullError(
+                f"admission queue full ({self.cfg.max_queue})") from None
+        self._metrics.inc("submitted")
+        # submit/close race: if close() won and the dispatcher already
+        # exited, nothing will ever drain this request — fail it now
+        # instead of hanging the caller.  (A dispatcher that is merely
+        # draining is still alive and will process it.)
+        if self._closed.is_set() and (
+                self._thread is None or not self._thread.is_alive()):
+            if not req.future.done():
+                req.future.set_exception(EngineClosedError("engine closed"))
+            raise EngineClosedError("engine is closed")
+        return req.future
+
+    def process(self, section: DasSection,
+                deadline_ms: Optional[float] = None,
+                session: Optional[str] = None,
+                timeout: Optional[float] = None) -> Any:
+        """Blocking convenience wrapper around :meth:`submit`."""
+        return self.submit(section, deadline_ms, session).result(timeout)
+
+    # -- introspection -------------------------------------------------------
+    def metrics(self) -> dict:
+        snap = self._metrics.snapshot()
+        snap["buckets"] = [list(b) for b in self.buckets]
+        snap["sessions"] = len(self.sessions)
+        return snap
+
+    def session_state(self, session: str) -> Any:
+        return self.sessions.get(session)
+
+    # -- dispatcher ----------------------------------------------------------
+    def _expired(self, req: _Request) -> bool:
+        if time.perf_counter() <= req.deadline:
+            return False
+        self._metrics.inc("shed_expired")
+        self.tracer.instant("shed", cat="serve", reason="deadline",
+                            bucket=list(req.bucket))
+        if not req.future.done():
+            req.future.set_exception(DeadlineExceededError(
+                f"deadline passed after "
+                f"{(time.perf_counter() - req.t_submit) * 1e3:.1f} ms in queue"))
+        return True
+
+    def _next_request(self, timeout: float) -> Optional[_Request]:
+        if self._stash:
+            return self._stash.popleft()
+        try:
+            return self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def _next_same_bucket(self, bucket: Bucket,
+                          linger_end: float) -> Optional[_Request]:
+        """A live same-bucket companion from stash/queue, or None once the
+        linger window closes.  Other-bucket requests are stashed (they head
+        a later batch, in arrival order)."""
+        for i, r in enumerate(self._stash):
+            if r.bucket == bucket:
+                del self._stash[i]
+                return r
+        while True:
+            remaining = linger_end - time.perf_counter()
+            if remaining <= 0:
+                return None
+            try:
+                r = self._queue.get(timeout=remaining)
+            except queue.Empty:
+                return None
+            if self._expired(r):
+                continue
+            if r.bucket == bucket:
+                return r
+            self._stash.append(r)
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            head = self._next_request(timeout=0.05)
+            if head is None:
+                if self._closed.is_set() and not self._stash \
+                        and self._queue.empty():
+                    return
+                continue
+            if self._expired(head):
+                continue
+            batch = [head]
+            linger_end = time.perf_counter() + self.cfg.batch_window_ms / 1e3
+            while len(batch) < self.cfg.max_batch:
+                nxt = self._next_same_bucket(head.bucket, linger_end)
+                if nxt is None:
+                    break
+                batch.append(nxt)
+            self._execute(batch)
+
+    def _execute(self, batch) -> None:
+        bucket = batch[0].bucket
+        program = self.cache.get(bucket)
+        self._metrics.observe_batch(len(batch))
+        self.tracer.counter("serve_batch", occupancy=len(batch))
+        for req in batch:
+            if self._expired(req):     # deadline may pass while batching
+                continue
+            t_dq = time.perf_counter()
+            self.tracer.complete("queue", req.t_submit_us, cat="serve",
+                                 bucket=list(bucket))
+            try:
+                t0 = time.perf_counter()
+                with self.tracer.span("pad", cat="serve",
+                                      valid=list(req.valid),
+                                      bucket=list(bucket)):
+                    padded = pad_section(req.section, bucket)
+                t1 = time.perf_counter()
+                with self.tracer.span("compute", cat="serve",
+                                      bucket=list(bucket)):
+                    result, state = program(padded, req.valid,
+                                            self.sessions.get(req.session))
+                t2 = time.perf_counter()
+                with self.tracer.span("unpad", cat="serve"):
+                    self.sessions.put(req.session, state)
+                    if not req.future.done():
+                        req.future.set_result(result)
+                t3 = time.perf_counter()
+            except Exception as e:
+                self._metrics.inc("errors")
+                log.exception("request failed in bucket %s", bucket)
+                if not req.future.done():
+                    req.future.set_exception(e)
+                continue
+            self._metrics.observe_request(
+                (t3 - req.t_submit) * 1e3,
+                {"queue": (t_dq - req.t_submit) * 1e3,
+                 "pad": (t1 - t0) * 1e3,
+                 "compute": (t2 - t1) * 1e3,
+                 "unpad": (t3 - t2) * 1e3})

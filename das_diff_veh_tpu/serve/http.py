@@ -1,0 +1,150 @@
+"""Thin stdlib-HTTP JSON front end for smoke-driving a ServingEngine.
+
+Deliberately minimal — a demo/debug surface, not a production gateway (no
+auth, JSON-array payloads, one engine per server):
+
+- ``POST /v1/process`` — body ``{"data": [[...]], "x": [...], "t": [...],
+  "deadline_ms": opt, "session": opt}``; responds with the result summary
+  (``?image=1`` to inline the full image values).
+- ``GET /v1/metrics`` — the engine's metrics snapshot.
+- ``GET /healthz`` — liveness + configured buckets.
+
+Shed responses map onto HTTP status codes: 429 for backpressure
+(queue full), 504 for a deadline that expired in queue, 413 for a shape no
+bucket fits, 400 for malformed payloads and for requests the compute
+factory's admission check rejects (e.g. geometry that does not match the
+warmed programs).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import asdict, is_dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse
+
+import numpy as np
+
+from das_diff_veh_tpu.core.section import DasSection
+from das_diff_veh_tpu.serve.engine import (DeadlineExceededError,
+                                           InvalidRequestError, NoBucketError,
+                                           QueueFullError, ServingEngine)
+
+
+def _jsonable(obj, full_arrays: bool = False):
+    """Best-effort JSON rendering of an arbitrary compute result: arrays
+    become summaries (or value lists with ``full_arrays``), dataclasses and
+    containers recurse."""
+    if is_dataclass(obj) and not isinstance(obj, type):
+        return _jsonable(asdict(obj), full_arrays)
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v, full_arrays) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v, full_arrays) for v in obj]
+    if isinstance(obj, np.ndarray):
+        if full_arrays:
+            return obj.tolist()
+        return {"shape": list(obj.shape), "dtype": str(obj.dtype),
+                "sum": float(obj.sum())}
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    return repr(obj)
+
+
+class ServeHandler(BaseHTTPRequestHandler):
+    """One handler class per server, bound to its engine via the factory in
+    :func:`make_server`."""
+
+    engine: ServingEngine = None       # set by make_server
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet by default; tracer has spans
+        pass
+
+    def _reply(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        path = urlparse(self.path).path
+        if path == "/healthz":
+            self._reply(200, {"ok": True,
+                              "buckets": [list(b) for b in
+                                          self.engine.buckets]})
+        elif path == "/v1/metrics":
+            self._reply(200, self.engine.metrics())
+        else:
+            self._reply(404, {"error": f"unknown path {path}"})
+
+    def do_POST(self):
+        url = urlparse(self.path)
+        if url.path != "/v1/process":
+            self._reply(404, {"error": f"unknown path {url.path}"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(n))
+            data = np.asarray(payload["data"], dtype=np.float32)
+            if data.ndim != 2:
+                raise ValueError(f"data must be 2-D, got shape {data.shape}")
+            x = np.asarray(payload.get(
+                "x", np.arange(data.shape[0])), dtype=np.float64)
+            t = np.asarray(payload.get(
+                "t", np.arange(data.shape[1])), dtype=np.float64)
+            deadline_ms = payload.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)
+            session = payload.get("session")
+        except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": f"bad request: {e}"})
+            return
+        section = DasSection(data, x, t)
+        try:
+            future = self.engine.submit(section, deadline_ms=deadline_ms,
+                                        session=session)
+            result = future.result()
+        except QueueFullError as e:
+            self._reply(429, {"error": str(e)})
+            return
+        except NoBucketError as e:
+            self._reply(413, {"error": str(e)})
+            return
+        except InvalidRequestError as e:
+            self._reply(400, {"error": str(e)})
+            return
+        except DeadlineExceededError as e:
+            self._reply(504, {"error": str(e)})
+            return
+        except Exception as e:
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        full = "image=1" in (url.query or "")
+        self._reply(200, {"result": _jsonable(result, full_arrays=full)})
+
+
+def make_server(engine: ServingEngine, host: str = "127.0.0.1",
+                port: int = 0) -> ThreadingHTTPServer:
+    """A ThreadingHTTPServer bound to ``engine`` (port 0 = ephemeral; the
+    bound port is ``server.server_address[1]``).  Caller owns serve_forever
+    / shutdown."""
+    handler = type("BoundServeHandler", (ServeHandler,), {"engine": engine})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve_in_thread(engine: ServingEngine, host: str = "127.0.0.1",
+                    port: int = 0):
+    """Start the server on a daemon thread; returns ``(server, thread)``."""
+    server = make_server(engine, host, port)
+    thread = threading.Thread(target=server.serve_forever,
+                              name="serve-http", daemon=True)
+    thread.start()
+    return server, thread

@@ -1,0 +1,169 @@
+"""The production compute factory: ``process_chunk`` behind the engine.
+
+One deployment serves one fiber section: the channel axis is fixed (the
+interrogator's geometry picks the static slice bounds inside the compiled
+program — host code like ``np.argmax(x >= start_x)`` turns ``x`` *values*
+into compile-time constants), while the record length ``nt`` varies with
+segment truncation.  Buckets should therefore share the deployment's
+``n_ch`` and tile the expected ``nt`` range; see docs/USAGE.md §serving.
+
+Geometry is enforced at admission (:meth:`ImagingComputeFactory.validate`,
+called by ``ServingEngine.submit``): channel-axis padding, a foreign x
+axis, or a wrong sample rate are rejected up front — mismatched geometry
+would otherwise re-trace the pipeline inline on the dispatcher thread
+(~40 s/shape on CPU) while the bucket cache still reported a hit, silently
+breaking the zero-compile guarantee.
+
+The time axis is *canonicalized*: compute rebases ``t`` onto the warmed
+``arange(nt) * (1/fs)`` grid (the result is time-origin invariant, and a
+large absolute ``t0`` — epoch seconds, hours into a recording — would both
+quantize the float time steps and change the compiled dt constant).  The
+segment's absolute start lands in ``ImagingResult.t0`` for provenance.
+A request whose shape equals its bucket and whose t axis already starts at
+0 therefore runs the identical program a direct ``process_chunk`` call
+would (bit-exact, asserted in tests/test_serve.py); a time-padded request
+computes on trailing zeros — the right semantics for a truncated tail
+segment, surfaced as ``ImagingResult.padded`` so callers can tell.
+
+Session state carries the batch workflow's accumulator across consecutive
+segments of one fiber: the running sum of per-segment average images and
+the vehicle count (``run_directory``'s ``avg_image += images.avg_image``
+semantics, online).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from das_diff_veh_tpu.config import PipelineConfig
+from das_diff_veh_tpu.core.section import DasSection
+from das_diff_veh_tpu.runtime.manifest import config_hash
+from das_diff_veh_tpu.serve.buckets import Bucket
+from das_diff_veh_tpu.serve.compile_cache import ComputeFactory, ComputeFn
+
+
+@dataclass
+class ImagingResult:
+    """One served segment: dispersion image + provenance."""
+
+    image: np.ndarray                  # (nvel, nfreq)
+    n_windows: int                     # isolated vehicles in this segment
+    valid: Tuple[int, int]             # the request's true (n_ch, nt)
+    bucket: Bucket                     # shape it executed at
+    padded: bool                       # valid != bucket (trailing zeros)
+    t0: float = 0.0                    # absolute segment start [s] (the
+                                       # compute itself runs origin-rebased)
+
+
+def _fresh_state() -> dict:
+    return {"avg_image": None, "n_windows": 0, "n_segments": 0}
+
+
+class ImagingComputeFactory(ComputeFactory):
+    """Builds per-bucket ``process_chunk`` programs for one fiber section.
+
+    ``x_axis`` is the deployment's channel axis (channel numbers when
+    ``x_is_channels``, meters otherwise), at least as long as the largest
+    bucket's ``n_ch`` — warmup uses its prefix so the warmed program is the
+    one real traffic hits.  ``fs`` fixes the canonical time grid.
+    """
+
+    def __init__(self, cfg: Optional[PipelineConfig] = None,
+                 method: str = "xcorr", x_is_channels: bool = True,
+                 x_axis: Optional[np.ndarray] = None, fs: float = 250.0):
+        self.cfg = cfg if cfg is not None else PipelineConfig()
+        self.method = method
+        self.x_is_channels = x_is_channels
+        self.fs = float(fs)
+        self._x_axis = None if x_axis is None else np.asarray(x_axis, np.float64)
+        self.config_key = config_hash(self.cfg, method, x_is_channels)
+
+    def _x_for(self, n_ch: int) -> np.ndarray:
+        if self._x_axis is not None:
+            if self._x_axis.size < n_ch:
+                raise ValueError(
+                    f"x_axis has {self._x_axis.size} channels, bucket "
+                    f"needs {n_ch}")
+            return self._x_axis[:n_ch]
+        it = self.cfg.interrogator
+        if self.x_is_channels:
+            return it.start_ch + np.arange(n_ch, dtype=np.float64)
+        return np.arange(n_ch, dtype=np.float64) * it.dx
+
+    def _canonical_t(self, nt: int) -> np.ndarray:
+        # same construction as io/synthetic.py's scene axis, so a t-axis
+        # that already starts at 0 rebases to itself bit-for-bit
+        return np.arange(nt, dtype=np.float64) * (1.0 / self.fs)
+
+    def validate(self, section: DasSection,
+                 bucket: Bucket) -> Optional[str]:
+        """Admission-time geometry check (engine calls this in ``submit``):
+        returns a rejection reason, or None for a servable request."""
+        n_ch, nt = section.data.shape
+        if int(n_ch) != int(bucket[0]):
+            return (f"channel-axis padding ({n_ch} -> {bucket[0]}) is not "
+                    "supported by the imaging factory: cross-channel "
+                    "filtering would see zero rows inside the aperture; "
+                    "configure buckets with the deployment's exact n_ch")
+        x = np.asarray(section.x)
+        expected_x = self._x_for(int(bucket[0]))
+        if x.shape != expected_x.shape or not np.array_equal(x, expected_x):
+            return ("request x axis does not match the deployment axis this "
+                    "engine was warmed for; serving is per-fiber — build a "
+                    "factory with this request's x_axis instead")
+        t = np.asarray(section.t)
+        dt = float(t[1] - t[0])
+        if not math.isclose(dt, 1.0 / self.fs, rel_tol=1e-6):
+            return (f"request sample interval {dt!r} != 1/fs "
+                    f"{1.0 / self.fs!r}: resample or build a factory with "
+                    "the matching fs")
+        return None
+
+    def warmup_section(self, bucket: Bucket) -> DasSection:
+        n_ch, nt = bucket
+        return DasSection(np.zeros(bucket, dtype=np.float32),
+                          self._x_for(n_ch), self._canonical_t(nt))
+
+    def build(self, bucket: Bucket) -> ComputeFn:
+        import jax
+
+        from das_diff_veh_tpu.pipeline.timelapse import process_chunk
+
+        canonical_t = self._canonical_t(bucket[1])
+
+        def compute(section: DasSection, valid: Tuple[int, int],
+                    state: Any) -> Tuple[ImagingResult, Any]:
+            # defense in depth for direct (engine-less) factory use; the
+            # engine already ran this at admission (the padded section
+            # passes the same checks: n_ch/x untouched, dt preserved)
+            err = self.validate(section, bucket)
+            if err is not None:
+                raise ValueError(err)
+            t = np.asarray(section.t)
+            t0 = float(t[0])
+            if not np.array_equal(t, canonical_t):
+                # rebase onto the warmed grid: origin-invariant result, and
+                # the compiled dt constant stays the canonical 1/fs
+                section = DasSection(section.data, section.x, canonical_t)
+            chunk = process_chunk(section, self.cfg, method=self.method,
+                                  x_is_channels=self.x_is_channels)
+            jax.block_until_ready(chunk.disp_image)
+            n = int(chunk.n_windows)
+            img = np.asarray(chunk.disp_image)
+            result = ImagingResult(image=img, n_windows=n,
+                                   valid=tuple(valid), bucket=bucket,
+                                   padded=tuple(valid) != tuple(bucket),
+                                   t0=t0)
+            state = dict(state) if state is not None else _fresh_state()
+            if n > 0:
+                state["avg_image"] = (img if state["avg_image"] is None
+                                      else state["avg_image"] + img)
+                state["n_windows"] += n
+            state["n_segments"] += 1
+            return result, state
+
+        return compute

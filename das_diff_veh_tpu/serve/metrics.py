@@ -1,0 +1,99 @@
+"""Thread-safe serving metrics: counters, latency percentiles, occupancy.
+
+One registry per engine.  Counters are plain monotonic ints; completed
+request latencies (and their per-stage spans) go into bounded rings so the
+snapshot's p50/p95/p99 reflect recent traffic without unbounded memory.
+``snapshot()`` returns one JSON-ready dict — the engine's metrics API and
+the HTTP ``/metrics`` endpoint both serve it verbatim.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile of an already-sorted sequence."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+    return float(sorted_vals[idx])
+
+
+class ServeMetrics:
+    """Counters + bounded latency reservoirs for one serving engine."""
+
+    _STAGES = ("queue", "pad", "compute", "unpad")
+
+    def __init__(self, latency_window: int = 1024):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {
+            "submitted": 0, "completed": 0, "errors": 0,
+            "shed_rejected": 0, "shed_expired": 0, "shed_no_bucket": 0,
+            "shed_invalid": 0,
+            "cache_hits": 0, "cache_misses": 0, "warmup_builds": 0,
+        }
+        self._latency = deque(maxlen=latency_window)       # total ms
+        self._stage = {s: deque(maxlen=latency_window) for s in self._STAGES}
+        self._batches = 0
+        self._batched_requests = 0
+        self._max_occupancy = 0
+        self._queue_depth_fn = None
+
+    # -- write side (engine threads) -----------------------------------------
+    def inc(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + by
+
+    def observe_batch(self, occupancy: int) -> None:
+        with self._lock:
+            self._batches += 1
+            self._batched_requests += occupancy
+            self._max_occupancy = max(self._max_occupancy, occupancy)
+
+    def observe_request(self, total_ms: float,
+                        stages_ms: Optional[Dict[str, float]] = None) -> None:
+        with self._lock:
+            self._counts["completed"] += 1
+            self._latency.append(total_ms)
+            for name, v in (stages_ms or {}).items():
+                self._stage.setdefault(
+                    name, deque(maxlen=self._latency.maxlen)).append(v)
+
+    def bind_queue_depth(self, fn) -> None:
+        """Register a zero-arg callable reporting the live queue depth."""
+        self._queue_depth_fn = fn
+
+    # -- read side -----------------------------------------------------------
+    def count(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            lat = sorted(self._latency)
+            snap = {
+                **self._counts,
+                "queue_depth": self._queue_depth_fn() if self._queue_depth_fn else 0,
+                "latency_ms": {
+                    "n": len(lat),
+                    "p50": round(_percentile(lat, 0.50), 3),
+                    "p95": round(_percentile(lat, 0.95), 3),
+                    "p99": round(_percentile(lat, 0.99), 3),
+                    "max": round(lat[-1], 3) if lat else 0.0,
+                },
+                "stages_ms": {
+                    name: round(sum(ring) / len(ring), 3) if ring else 0.0
+                    for name, ring in self._stage.items()
+                },
+                "batch": {
+                    "count": self._batches,
+                    "mean_occupancy": round(
+                        self._batched_requests / self._batches, 3)
+                        if self._batches else 0.0,
+                    "max_occupancy": self._max_occupancy,
+                },
+            }
+        return snap

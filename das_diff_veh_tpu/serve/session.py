@@ -1,0 +1,48 @@
+"""Streaming session state: consecutive segments of one fiber share state.
+
+A DAS interrogator produces an endless record; online callers submit it as
+consecutive time segments.  ``SessionStore`` keeps an opaque per-session
+value that the engine threads through the compute function — segment k's
+compute receives the state segment k-1 returned (the imaging compute uses
+it to carry the running dispersion-image accumulator and vehicle count, so
+a session behaves like the batch workflow's per-date accumulator).
+
+All state updates happen on the single dispatcher thread in execution
+order, so no per-session locking is needed beyond the store's own map lock.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+
+class SessionStore:
+    """Thread-safe map of session id -> opaque compute state."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state: Dict[str, Any] = {}
+
+    def get(self, session: Optional[str]) -> Any:
+        if session is None:
+            return None
+        with self._lock:
+            return self._state.get(session)
+
+    def put(self, session: Optional[str], state: Any) -> None:
+        if session is None:
+            return
+        with self._lock:
+            if state is None:
+                self._state.pop(session, None)
+            else:
+                self._state[session] = state
+
+    def drop(self, session: str) -> None:
+        with self._lock:
+            self._state.pop(session, None)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._state)

@@ -38,9 +38,8 @@ from __future__ import annotations
 import os
 from typing import Optional, Sequence
 
-import numpy as np
-
 import matplotlib
+import numpy as np
 
 if not os.environ.get("DISPLAY") and not os.environ.get("MPLBACKEND"):
     # headless fallback only — never clobber an interactive session's backend

@@ -9,8 +9,8 @@ trajectories — the shapes the reference's 700 m imaging path processes
 
 from __future__ import annotations
 
-import numpy as np
 import jax.numpy as jnp
+import numpy as np
 
 from das_diff_veh_tpu.config import GatherConfig, WindowConfig
 from das_diff_veh_tpu.core.section import WindowBatch

@@ -2,8 +2,8 @@
 
 import os
 
-import numpy as np
 import jax.numpy as jnp
+import numpy as np
 import pytest
 from scipy import signal as ssig
 

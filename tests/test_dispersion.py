@@ -1,9 +1,9 @@
-import numpy as np
 import jax.numpy as jnp
+import numpy as np
 
+from das_diff_veh_tpu.io.synthetic import dispersive_shot
 from das_diff_veh_tpu.ops import dispersion as jd
 from das_diff_veh_tpu.oracle import dispersion_ref as od
-from das_diff_veh_tpu.io.synthetic import dispersive_shot
 
 RNG = np.random.default_rng(11)
 

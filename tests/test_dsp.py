@@ -1,5 +1,5 @@
-import numpy as np
 import jax.numpy as jnp
+import numpy as np
 from scipy import signal as sp_signal
 
 from das_diff_veh_tpu import ops

@@ -7,9 +7,9 @@ the box blur (which the reference always applies after) is checked to
 +-1 level.
 """
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
-import jax.numpy as jnp
 
 cv2 = pytest.importorskip("cv2")
 

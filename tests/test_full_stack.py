@@ -14,15 +14,16 @@ Every stage is independently parity-tested elsewhere; this test proves they
 *compose*.
 """
 
-import numpy as np
 import jax.numpy as jnp
+import numpy as np
 
 from das_diff_veh_tpu.analysis.bootstrap import bootstrap_disp, sample_indices
 from das_diff_veh_tpu.config import (BootstrapConfig, ImagingConfig,
                                      PipelineConfig)
 from das_diff_veh_tpu.inversion.curves import curves_from_ridges
-from das_diff_veh_tpu.inversion.forward import (LayeredModel, phase_velocity,
+from das_diff_veh_tpu.inversion.forward import (LayeredModel,
                                                 density_gardner_linear,
+                                                phase_velocity,
                                                 vp_from_poisson)
 from das_diff_veh_tpu.inversion.invert import LayerBounds, ModelSpec, invert
 from das_diff_veh_tpu.io.synthetic import SceneConfig, synthesize_section

@@ -3,7 +3,8 @@ import pytest
 
 from das_diff_veh_tpu.core.section import DasSection
 from das_diff_veh_tpu.io import readers, segy
-from das_diff_veh_tpu.io.synthetic import SceneConfig, synthesize_section, dispersive_shot
+from das_diff_veh_tpu.io.synthetic import (SceneConfig, dispersive_shot,
+                                           synthesize_section)
 
 
 def test_npz_roundtrip(tmp_path):

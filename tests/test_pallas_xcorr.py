@@ -4,12 +4,12 @@ the streamed variants.  The kernel itself runs in interpreter mode here
 (CPU CI); the real-TPU path is exercised by bench.py."""
 
 import jax
-import numpy as np
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
-from das_diff_veh_tpu.ops.pallas_xcorr import (peak_from_spectra,
-                                               _window_spectra,
+from das_diff_veh_tpu.ops.pallas_xcorr import (_window_spectra,
+                                               peak_from_spectra,
                                                xcorr_all_pairs,
                                                xcorr_all_pairs_peak)
 from das_diff_veh_tpu.ops.xcorr import xcorr_vshot_batch

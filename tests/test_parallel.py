@@ -1,12 +1,14 @@
-import numpy as np
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-from das_diff_veh_tpu.config import DispersionConfig, GatherConfig, WindowConfig
+from das_diff_veh_tpu.config import (DispersionConfig, GatherConfig,
+                                     WindowConfig)
 from das_diff_veh_tpu.models import vsg as V
 from das_diff_veh_tpu.models.vsg import VsgGeometry
 from das_diff_veh_tpu.parallel import make_mesh
-from das_diff_veh_tpu.parallel.stack import shard_windows, sharded_stack_pipeline
+from das_diff_veh_tpu.parallel.stack import (shard_windows,
+                                             sharded_stack_pipeline)
 from das_diff_veh_tpu.workloads import make_window_batch
 
 

@@ -1,0 +1,472 @@
+"""Serving-engine tests: bucketing, shedding, metrics, sessions, HTTP, CLI.
+
+Dispatcher behavior is driven with injected stub compute factories
+(``FnComputeFactory``) so tier-1 never traces ``process_chunk`` on a new
+shape; the one real-compute case reuses a single small geometry and runs
+``process_chunk`` exactly twice (engine vs direct) to pin bit-exactness of
+the pad -> compute -> unpad round trip on the production path.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from das_diff_veh_tpu.config import (ImagingConfig, PipelineConfig,
+                                     ServeConfig, TrackingConfig)
+from das_diff_veh_tpu.core.section import DasSection
+from das_diff_veh_tpu.runtime import load_trace, make_tracer
+from das_diff_veh_tpu.serve import (DeadlineExceededError, EngineClosedError,
+                                    FnComputeFactory, ImagingComputeFactory,
+                                    InvalidRequestError, NoBucketError,
+                                    QueueFullError, ServingEngine,
+                                    normalize_buckets, pad_section,
+                                    pick_bucket, serve_in_thread)
+
+
+# --------------------------------------------------------------------------
+# helpers
+# --------------------------------------------------------------------------
+
+def _section(nch, nt, value=1.0, dtype=np.float32):
+    return DasSection(np.full((nch, nt), value, dtype),
+                      np.arange(nch, dtype=np.float64) * 8.16,
+                      np.arange(nt, dtype=np.float64) / 250.0)
+
+
+def _sum_build(bucket):
+    """Stub compute: padding-invariant sum over the valid region, with the
+    running session total as state."""
+    def fn(section, valid, state):
+        assert tuple(section.data.shape) == tuple(bucket)  # engine padded
+        d = np.asarray(section.data)[:valid[0], :valid[1]]
+        total = float(d.sum())
+        return {"sum": total, "valid": tuple(valid)}, (state or 0.0) + total
+    return fn
+
+
+def _engine(buckets=((8, 32), (16, 64)), compute=_sum_build, **kw):
+    cfg = ServeConfig(buckets=buckets, **kw)
+    return ServingEngine(FnComputeFactory(compute, "test"), cfg).start()
+
+
+class _Gate:
+    """Blocks the dispatcher inside compute until released."""
+
+    def __init__(self):
+        self.started = threading.Event()
+        self.release = threading.Event()
+
+    def build(self, bucket):
+        def fn(section, valid, state):
+            self.started.set()
+            assert self.release.wait(timeout=30.0)
+            return float(np.asarray(section.data)[:valid[0], :valid[1]].sum()), state
+        return fn
+
+
+# --------------------------------------------------------------------------
+# buckets
+# --------------------------------------------------------------------------
+
+def test_pick_bucket_smallest_fit():
+    buckets = ((16, 64), (8, 32), (8, 128))
+    assert normalize_buckets(buckets)[0] == (8, 32)
+    assert pick_bucket((8, 32), buckets) == (8, 32)
+    assert pick_bucket((5, 20), buckets) == (8, 32)
+    assert pick_bucket((8, 40), buckets) == (8, 128)   # area-smallest fit
+    assert pick_bucket((9, 32), buckets) == (16, 64)
+    assert pick_bucket((17, 10), buckets) is None
+    assert pick_bucket((8, 200), buckets) is None
+
+
+def test_pad_section_round_trip():
+    sec = _section(5, 20, 3.0)
+    padded = pad_section(sec, (8, 32))
+    assert padded.data.shape == (8, 32)
+    d = np.asarray(padded.data)
+    assert np.array_equal(d[:5, :20], np.asarray(sec.data))
+    assert not d[5:].any() and not d[:, 20:].any()
+    # axes continue their own spacing (dx/dt derived downstream unchanged)
+    assert np.allclose(np.diff(np.asarray(padded.x)), 8.16)
+    assert np.allclose(np.diff(np.asarray(padded.t)), 1.0 / 250.0)
+    # exact-shape fast path: nothing copied
+    same = pad_section(sec, (5, 20))
+    assert same.data is sec.data
+
+
+def test_pad_section_too_big_raises():
+    with pytest.raises(ValueError, match="does not fit"):
+        pad_section(_section(9, 10), (8, 32))
+
+
+# --------------------------------------------------------------------------
+# engine: pad -> compute -> unpad round trip + compile-cache counters
+# --------------------------------------------------------------------------
+
+def test_engine_round_trip_equals_direct_and_zero_misses():
+    """Engine output over assorted in-bucket shapes equals the stub applied
+    directly to each unpadded section, and after AOT warmup the request
+    stream performs zero new compilations."""
+    eng = _engine()
+    try:
+        shapes = [(8, 32), (5, 20), (3, 32), (8, 1), (16, 64), (9, 33)]
+        for nch, nt in shapes:
+            sec = _section(nch, nt, value=0.5 + nch)
+            got = eng.process(sec, timeout=30)
+            direct, _ = _sum_build((nch, nt))(sec, (nch, nt), None)
+            assert got["sum"] == direct["sum"]
+            assert got["valid"] == (nch, nt)
+        m = eng.metrics()
+        assert m["warmup_builds"] == 2          # one AOT build per bucket
+        assert m["cache_misses"] == 0           # steady state never compiles
+        assert m["cache_hits"] == len(shapes)
+        assert m["completed"] == len(shapes)
+    finally:
+        eng.close()
+
+
+def test_no_warmup_first_request_is_a_counted_miss():
+    eng = _engine(warmup=False)
+    try:
+        eng.process(_section(4, 16), timeout=30)
+        m = eng.metrics()
+        assert m["warmup_builds"] == 0 and m["cache_misses"] == 1
+    finally:
+        eng.close()
+
+
+def test_no_bucket_rejection():
+    eng = _engine()
+    try:
+        with pytest.raises(NoBucketError):
+            eng.submit(_section(17, 10))
+        assert eng.metrics()["shed_no_bucket"] == 1
+    finally:
+        eng.close()
+
+
+# --------------------------------------------------------------------------
+# engine: backpressure + deadline shedding
+# --------------------------------------------------------------------------
+
+def test_backpressure_rejects_on_full():
+    gate = _Gate()
+    eng = ServingEngine(FnComputeFactory(gate.build, "gated"),
+                        ServeConfig(buckets=((8, 32),), max_batch=1,
+                                    max_queue=2, warmup=False)).start()
+    try:
+        blocked = eng.submit(_section(8, 32))
+        assert gate.started.wait(timeout=10)    # dispatcher is inside compute
+        ok = [eng.submit(_section(8, 32)) for _ in range(2)]  # fills queue
+        with pytest.raises(QueueFullError):
+            eng.submit(_section(8, 32))
+        assert eng.metrics()["shed_rejected"] == 1
+        gate.release.set()
+        for f in [blocked, *ok]:
+            assert isinstance(f.result(timeout=30), float)
+        m = eng.metrics()
+        assert m["completed"] == 3 and m["queue_depth"] == 0
+    finally:
+        gate.release.set()
+        eng.close()
+
+
+def test_deadline_expires_in_queue():
+    gate = _Gate()
+    eng = ServingEngine(FnComputeFactory(gate.build, "gated"),
+                        ServeConfig(buckets=((8, 32),), max_batch=4,
+                                    max_queue=8, warmup=False)).start()
+    try:
+        blocked = eng.submit(_section(8, 32), deadline_ms=60000.0)
+        assert gate.started.wait(timeout=10)
+        doomed = eng.submit(_section(8, 32), deadline_ms=1.0)
+        time.sleep(0.05)                        # let the 1 ms deadline pass
+        gate.release.set()
+        assert isinstance(blocked.result(timeout=30), float)
+        with pytest.raises(DeadlineExceededError):
+            doomed.result(timeout=30)
+        m = eng.metrics()
+        assert m["shed_expired"] == 1 and m["completed"] == 1
+    finally:
+        gate.release.set()
+        eng.close()
+
+
+def test_compute_error_fails_one_request_not_the_engine():
+    def build(bucket):
+        def fn(section, valid, state):
+            if float(np.asarray(section.data).flat[0]) < 0:
+                raise ValueError("poisoned request")
+            return "ok", state
+        return fn
+
+    eng = _engine(compute=build)
+    try:
+        bad = eng.submit(_section(4, 16, value=-1.0))
+        assert isinstance(bad.exception(timeout=30), ValueError)
+        assert eng.process(_section(4, 16, value=1.0), timeout=30) == "ok"
+        m = eng.metrics()
+        assert m["errors"] == 1 and m["completed"] == 1
+    finally:
+        eng.close()
+
+
+def test_closed_engine_rejects_submits_and_restarts():
+    eng = _engine()
+    eng.close()
+    with pytest.raises(EngineClosedError):
+        eng.submit(_section(4, 16))
+    # a closed engine cannot be resurrected into a dispatcherless zombie
+    with pytest.raises(EngineClosedError):
+        eng.start()
+
+
+def test_imaging_factory_rejects_mismatched_geometry():
+    """The zero-compile guard: channel padding, a foreign x axis, or a
+    wrong sample rate are shed AT SUBMIT (never queued, never traced —
+    this test pays no compile), while an absolute-time axis at the right
+    rate is admitted (compute rebases the origin)."""
+    x_axis = np.arange(16, dtype=np.float64) * 8.16
+    factory = ImagingComputeFactory(PipelineConfig(), x_is_channels=False,
+                                    x_axis=x_axis, fs=250.0)
+    eng = ServingEngine(factory, ServeConfig(buckets=((16, 64),),
+                                             warmup=False)).start()
+    try:
+        def sec(nch, x=None, dt=1.0 / 250.0, t0=0.0):
+            xs = x_axis[:nch] if x is None else x
+            return DasSection(np.zeros((nch, 64), np.float32), xs,
+                              t0 + np.arange(64, dtype=np.float64) * dt)
+
+        with pytest.raises(InvalidRequestError, match="channel-axis padding"):
+            eng.submit(sec(12))
+        with pytest.raises(InvalidRequestError, match="x axis does not match"):
+            eng.submit(sec(16, x=np.arange(16.0)))
+        with pytest.raises(InvalidRequestError, match="sample interval"):
+            eng.submit(sec(16, dt=1.0 / 500.0))
+        m = eng.metrics()
+        assert m["shed_invalid"] == 3 and m["errors"] == 0
+        # streaming sessions carry absolute time: admitted, not rejected
+        assert factory.validate(sec(16, t0=7200.0), (16, 64)) is None
+    finally:
+        eng.close()
+
+
+# --------------------------------------------------------------------------
+# engine: microbatching + sessions + metrics + traces
+# --------------------------------------------------------------------------
+
+def test_microbatch_groups_same_bucket_requests():
+    gate = _Gate()
+    eng = ServingEngine(FnComputeFactory(gate.build, "gated"),
+                        ServeConfig(buckets=((8, 32),), max_batch=8,
+                                    max_queue=16, warmup=False)).start()
+    try:
+        first = eng.submit(_section(8, 32))
+        assert gate.started.wait(timeout=10)
+        rest = [eng.submit(_section(6, 20)) for _ in range(3)]
+        gate.release.set()
+        for f in [first, *rest]:
+            f.result(timeout=30)
+        b = eng.metrics()["batch"]
+        assert b["max_occupancy"] >= 3          # the 3 queued ones grouped
+        assert b["count"] < 4
+    finally:
+        gate.release.set()
+        eng.close()
+
+
+def test_session_state_carries_across_requests():
+    eng = _engine()
+    try:
+        for i in range(3):
+            eng.process(_section(8, 32, value=1.0), session="fiber-a",
+                        timeout=30)
+        eng.process(_section(8, 32, value=2.0), session="fiber-b", timeout=30)
+        eng.process(_section(8, 32, value=1.0), timeout=30)  # sessionless
+        assert eng.session_state("fiber-a") == 3 * 8 * 32
+        assert eng.session_state("fiber-b") == 2 * 8 * 32
+        assert eng.session_state("missing") is None
+        assert eng.metrics()["sessions"] == 2
+        eng.sessions.drop("fiber-a")
+        assert eng.session_state("fiber-a") is None
+    finally:
+        eng.close()
+
+
+def test_metrics_snapshot_counters_and_percentiles():
+    eng = _engine()
+    try:
+        for _ in range(5):
+            eng.process(_section(4, 16), timeout=30)
+        m = eng.metrics()
+        assert m["submitted"] == m["completed"] == 5
+        assert m["queue_depth"] == 0
+        lat = m["latency_ms"]
+        assert lat["n"] == 5
+        assert 0 <= lat["p50"] <= lat["p95"] <= lat["p99"] <= lat["max"]
+        assert set(m["stages_ms"]) >= {"queue", "pad", "compute", "unpad"}
+        assert m["buckets"] == [[8, 32], [16, 64]]
+    finally:
+        eng.close()
+
+
+def test_request_spans_valid_chrome_trace(tmp_path):
+    path = str(tmp_path / "serve_trace.jsonl")
+    tracer = make_tracer(path)
+    cfg = ServeConfig(buckets=((8, 32),))
+    eng = ServingEngine(FnComputeFactory(_sum_build, "t"), cfg,
+                        tracer=tracer).start()
+    try:
+        for _ in range(2):
+            eng.process(_section(5, 20), timeout=30)
+    finally:
+        eng.close()
+        tracer.close()
+    events = load_trace(path)                   # validates every line
+    spans = [e for e in events if e["ph"] == "X"]
+    names = {e["name"] for e in spans}
+    assert {"warmup", "queue", "pad", "compute", "unpad"} <= names
+    # the cross-thread queue span (submit -> dispatcher) has a sane duration
+    queue_spans = [e for e in spans if e["name"] == "queue"]
+    assert len(queue_spans) == 2
+    assert all(e["dur"] >= 0 for e in queue_spans)
+    assert {"serve_batch"} <= {e["name"] for e in events if e["ph"] == "C"}
+
+
+def test_compilation_cache_dir_knob(tmp_path):
+    import jax
+    before = jax.config.jax_compilation_cache_dir
+    try:
+        eng = _engine(buckets=((4, 8),),
+                      compilation_cache_dir=str(tmp_path / "xla"))
+        eng.close()
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path / "xla")
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)
+
+
+# --------------------------------------------------------------------------
+# HTTP front
+# --------------------------------------------------------------------------
+
+def _post(base, path, payload):
+    req = urllib.request.Request(base + path, json.dumps(payload).encode(),
+                                 {"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_http_smoke():
+    eng = _engine(buckets=((8, 32),))
+    server, _ = serve_in_thread(eng)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        with urllib.request.urlopen(base + "/healthz", timeout=15) as r:
+            health = json.loads(r.read())
+        assert health == {"ok": True, "buckets": [[8, 32]]}
+
+        code, body = _post(base, "/v1/process",
+                           {"data": np.ones((4, 16)).tolist(),
+                            "session": "s"})
+        assert code == 200 and body["result"]["sum"] == 64.0
+
+        code, _ = _post(base, "/v1/process", {"data": np.ones((9, 40)).tolist()})
+        assert code == 413                      # no bucket fits
+        code, _ = _post(base, "/v1/process", {"wrong": "keys"})
+        assert code == 400
+        code, _ = _post(base, "/v1/nope", {})
+        assert code == 404
+
+        with urllib.request.urlopen(base + "/v1/metrics", timeout=15) as r:
+            m = json.loads(r.read())
+        assert m["completed"] == 1 and m["shed_no_bucket"] == 1
+    finally:
+        server.shutdown()
+        server.server_close()
+        eng.close()
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+def test_serve_cli_parser():
+    from das_diff_veh_tpu.serve.cli import build_serve_parser, parse_buckets
+    assert parse_buckets("140x30000,100x15000") == ((140, 30000), (100, 15000))
+    args = build_serve_parser().parse_args(
+        ["--buckets", "100x15000", "--port", "0", "--x0", "400",
+         "--max_batch", "2", "--deadline_ms", "5000",
+         "--compilation_cache_dir", "/tmp/xla"])
+    assert args.buckets == ((100, 15000),)
+    assert args.max_batch == 2 and args.deadline_ms == 5000.0
+    assert args.compilation_cache_dir == "/tmp/xla"
+
+
+def test_cli_serve_subcommand_dispatch(monkeypatch):
+    import das_diff_veh_tpu.serve.cli as serve_cli
+    from das_diff_veh_tpu.pipeline.cli import main
+    seen = {}
+    monkeypatch.setattr(serve_cli, "serve_main",
+                        lambda argv: seen.setdefault("argv", argv) and 0 or 0)
+    assert main(["serve", "--buckets", "8x32"]) == 0
+    assert seen["argv"] == ["--buckets", "8x32"]
+
+
+def test_cli_batch_compilation_cache_flag():
+    from das_diff_veh_tpu.pipeline.cli import build_parser
+    args = build_parser().parse_args(
+        ["--data_root", "/d", "--start_date", "20230301",
+         "--end_date", "20230301", "--compilation_cache_dir", "/tmp/xla"])
+    assert args.compilation_cache_dir == "/tmp/xla"
+
+
+# --------------------------------------------------------------------------
+# the one real-compute case: production path bit-exactness
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def small_scene():
+    from das_diff_veh_tpu.io.synthetic import SceneConfig, synthesize_section
+    cfg = SceneConfig(nch=100, duration=60.0, n_vehicles=2, seed=11,
+                      speed_range=(12.0, 18.0))
+    return synthesize_section(cfg)
+
+
+def test_real_imaging_engine_bit_exact(small_scene):
+    """Engine round trip on the production ``process_chunk`` path equals the
+    direct call bit-for-bit, and the session accumulator matches the batch
+    workflow's semantics.  One small geometry, reduced static capacities,
+    exactly two process_chunk executions."""
+    from das_diff_veh_tpu.pipeline.timelapse import process_chunk
+    section, _ = small_scene
+    pcfg = PipelineConfig().replace(
+        imaging=ImagingConfig(x0=400.0), max_windows=4,
+        tracking=TrackingConfig(max_vehicles=8))
+    shape = tuple(int(s) for s in section.data.shape)
+    factory = ImagingComputeFactory(pcfg, method="xcorr", x_is_channels=False,
+                                    x_axis=np.asarray(section.x), fs=250.0)
+    eng = ServingEngine(factory, ServeConfig(
+        buckets=(shape,), warmup=False, default_deadline_ms=600000.0)).start()
+    try:
+        res = eng.process(DasSection(np.asarray(section.data),
+                                     np.asarray(section.x),
+                                     np.asarray(section.t)),
+                          session="fiber", timeout=600)
+    finally:
+        eng.close()
+    direct = process_chunk(section, pcfg, method="xcorr", x_is_channels=False)
+    assert res.n_windows == int(direct.n_windows) >= 1
+    assert np.array_equal(res.image, np.asarray(direct.disp_image))
+    assert res.valid == res.bucket == shape and not res.padded
+    state = eng.session_state("fiber")
+    assert state["n_segments"] == 1
+    assert state["n_windows"] == res.n_windows
+    assert np.array_equal(state["avg_image"], res.image)

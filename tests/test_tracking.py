@@ -1,5 +1,5 @@
-import numpy as np
 import jax.numpy as jnp
+import numpy as np
 import pytest
 from scipy.signal import find_peaks as scipy_find_peaks
 

@@ -2,12 +2,12 @@
 norm_part re-normalization matches the reference algebra
 (modules/utils.py:528-543)."""
 
+import matplotlib
 import numpy as np
 
-import matplotlib
 matplotlib.use("Agg")
 
-from das_diff_veh_tpu import viz
+from das_diff_veh_tpu import viz  # noqa: E402
 
 RNG = np.random.default_rng(3)
 

@@ -1,12 +1,12 @@
-import numpy as np
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from das_diff_veh_tpu.config import GatherConfig
 from das_diff_veh_tpu.models import vsg as V
-from das_diff_veh_tpu.oracle import vsg_ref as OV
 from das_diff_veh_tpu.ops import xcorr as jx
+from das_diff_veh_tpu.oracle import vsg_ref as OV
 from das_diff_veh_tpu.oracle import xcorr_ref as ox
 
 RNG = np.random.default_rng(23)
