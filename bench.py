@@ -16,8 +16,10 @@ section is written to ``bench_profile/`` for the perf narrative.  The other
 BASELINE configs are timed into ``extra``: 3-class vmapped dispersion images
 (config 2), amortized per-chunk cost + 24 h projection (config 3), and on
 TPU backends the Pallas all-pairs engine (config 4): unsharded 4096- and
-10000-channel runs, the shard_map'd Pallas path on the device mesh with
-parity vs the unsharded kernel, and a minutes-long (nt = 61440) record
+10000-channel runs, the ring-pipelined shard_map path on the device mesh
+(``ring_*`` keys: receiver-spectra shards rotating via ppermute, parity vs
+the unsharded kernel, and a replicated-vs-ring per-device peak-bytes A/B
+from ``device.memory_stats()``), and a minutes-long (nt = 61440) record
 through the win_block-streamed kernel with its record-length-invariance
 ratio.  An end-to-end batch-runtime entry measures chunks/s of the serial loop vs
 the prefetching executor on a synthetic compressed-npz directory
@@ -482,25 +484,54 @@ def main() -> None:
             rate_4k * nwin_of(nt), 1)
 
         # sharded tier ON CHIP: parallel.allpairs runs the same Pallas kernel
-        # under shard_map (source rows sharded over every available device —
-        # one on this rig), with parity against the unsharded result above
+        # under shard_map as a RING pipeline (receiver spectra shards rotate
+        # via ppermute; one device on this rig makes the ring degenerate but
+        # exercises the code path), with parity against the unsharded result
+        # above.  The replicated-vs-ring memory A/B happens in the 10k
+        # section below — ring first, replicated last, because peak-bytes
+        # counters are cumulative.
         if not os.environ.get("BENCH_SKIP_SHARDED"):
+            from das_diff_veh_tpu.config import RingConfig
             from das_diff_veh_tpu.parallel import (make_mesh,
                                                    sharded_all_pairs_peak)
 
             mesh = make_mesh()
-            fsh = jax.jit(lambda d: sharded_all_pairs_peak(
-                d, wlen4, mesh, src_chunk=64, use_pallas=True))
-            sh = jax.block_until_ready(fsh(big))         # compile
-            t0 = time.perf_counter()
-            sh = jax.block_until_ready(fsh(big))
-            dt_sh = time.perf_counter() - t0
-            extra["pallas_sharded_4k_s"] = round(dt_sh, 3)
-            extra["pallas_sharded_4k_pairs_per_sec"] = round(
-                nch * nch / dt_sh, 1)
-            extra["pallas_sharded_n_devices"] = int(mesh.devices.size)
-            extra["pallas_sharded_parity_max_abs_diff"] = float(
+            n_dev = int(mesh.devices.size)
+
+            def peak_bytes():
+                # min over mesh devices: every ring participant does the
+                # same work, but device 0 additionally carries the earlier
+                # unsharded benches in its cumulative peak counter — the
+                # cleanest device is the honest per-device working set
+                try:
+                    stats = [d.memory_stats() for d in mesh.devices.flat]
+                    return min(s["peak_bytes_in_use"] for s in stats)
+                except Exception:
+                    return None                 # platform has no allocator stats
+
+            def bench_ring(data, n, src_chunk, cfg, key):
+                f = jax.jit(lambda d: sharded_all_pairs_peak(
+                    d, wlen4, mesh, src_chunk=src_chunk, use_pallas=True,
+                    ring=cfg))
+                out = jax.block_until_ready(f(data))     # compile
+                t0 = time.perf_counter()
+                out = jax.block_until_ready(f(data))
+                dt = time.perf_counter() - t0
+                extra[f"{key}_s"] = round(dt, 3)
+                extra[f"{key}_pairs_per_sec"] = round(n * n / dt, 1)
+                return out
+
+            sh = bench_ring(big, nch, 64, RingConfig(), "ring_4k")
+            extra["ring_n_devices"] = n_dev
+            extra["ring_4k_parity_max_abs_diff"] = float(
                 jnp.max(jnp.abs(sh - peak4k)))
+            # legacy keys (pre-ring name) so BENCH history stays comparable
+            extra["pallas_sharded_4k_s"] = extra["ring_4k_s"]
+            extra["pallas_sharded_4k_pairs_per_sec"] = \
+                extra["ring_4k_pairs_per_sec"]
+            extra["pallas_sharded_n_devices"] = n_dev
+            extra["pallas_sharded_parity_max_abs_diff"] = \
+                extra["ring_4k_parity_max_abs_diff"]
 
         # minutes-long record (nt ~ 60k = 1 min at 1 kHz) through the
         # win_block kernel-grid streaming (auto-engaged: 119 windows), with a
@@ -539,6 +570,35 @@ def main() -> None:
             extra["pallas_allpairs_10k_src_chunk"] = sc10
             extra["pallas_allpairs_10k_vs_4k_rate"] = round(
                 rate_10k / rate_4k, 3)
+
+            # ring at the 10k spec + the per-device peak-memory A/B.  Ring
+            # runs FIRST so its peak-bytes reading (min over mesh devices)
+            # is not polluted by the replicated layout's O(nch) footprint;
+            # the replicated/ring ratio should approach the device count D
+            # (>= ~0.8*D on a multi-chip mesh — on this 1-chip rig both
+            # layouts hold the full set and the ratio sits near 1,
+            # disclosed via ring_n_devices).  The ratio is a LOWER bound
+            # on the true layout ratio: mode-independent allocations (the
+            # replicated (nch, nt) input record, earlier bench footprints)
+            # appear in both peaks, diluting it — the structural
+            # no-broadcast jaxpr pin in tests/test_parallel.py is the
+            # primary O(nch/D) guarantee, this number is supporting
+            # evidence.
+            if not os.environ.get("BENCH_SKIP_SHARDED"):
+                bench_ring(big10, nch10, sc10, RingConfig(), "ring_10k")
+                ring_peak = peak_bytes()
+                extra["ring_10k_vs_4k_rate"] = round(
+                    extra["ring_10k_pairs_per_sec"]
+                    / extra["ring_4k_pairs_per_sec"], 3)
+                if ring_peak is not None:
+                    extra["ring_10k_peak_bytes_per_device"] = ring_peak
+                bench_ring(big10, nch10, sc10,
+                           RingConfig(mode="replicated"), "replicated_10k")
+                repl_peak = peak_bytes()
+                if ring_peak is not None and repl_peak is not None:
+                    extra["replicated_10k_peak_bytes_per_device"] = repl_peak
+                    extra["replicated_vs_ring_peak_bytes_ratio"] = round(
+                        repl_peak / max(ring_peak, 1), 3)
 
     assert bool(jnp.isfinite(img).all()), "benchmark produced non-finite image"
     # primary = per-build device time amortized over K in-dispatch builds:

@@ -202,6 +202,41 @@ class BootstrapConfig:
 
 
 @dataclass(frozen=True)
+class RingConfig:
+    """Multi-chip all-pairs ring pipeline knobs (``parallel.allpairs``).
+
+    Execution knobs, not physics: on the kernel path every mode/buffering
+    choice produces bit-identical peaks (pinned by tests/test_parallel.py
+    on the 8-device CPU mesh); the einsum fallback agrees across choices
+    to dot_general reduction-order tolerance (~1e-7 relative, held to 2e-5
+    in tests).  They trade per-device memory against collective traffic.
+    """
+
+    mode: str = "ring"
+    """``"ring"``: each device keeps only its own nch/D receiver-spectra
+    shard and the shards rotate around the mesh via ``lax.ppermute`` —
+    per-device receiver memory is O(nch/D).  ``"replicated"``: the pre-ring
+    layout (full receiver set on every device, no collectives in the loop) —
+    per-device memory O(nch), kept for A/B benchmarking and single-chip
+    deployments where the broadcast is free."""
+
+    double_buffer: bool = True
+    """Issue step k+1's receiver-shard ``ppermute`` before step k's
+    correlation so XLA's latency-hiding scheduler overlaps the ICI transfer
+    with the Pallas compute (the ring-attention decomposition).  False
+    gates each rotation on the finished correlation through a
+    ``lax.optimization_barrier`` so transfer and compute truly serialize —
+    only useful for isolating ICI time in a profile (without the barrier
+    both orderings trace to the same dependency graph)."""
+
+    lagmax_block: Optional[int] = None
+    """Receiver rows per fused irfft + Pallas lag-max pass inside the peak
+    finish (``ops.pallas_xcorr.peak_from_spectra``).  None = fuse on the
+    kernel path with the default block; 0 = unfused XLA finish; >0 = that
+    block size."""
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Online serving engine knobs (``das_diff_veh_tpu.serve``).
 

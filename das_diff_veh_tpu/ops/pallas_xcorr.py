@@ -44,6 +44,14 @@ large channel counts AND arbitrarily long records run in bounded memory on
 both the lag-domain (``xcorr_all_pairs``) and peak (``xcorr_all_pairs_peak``)
 paths.
 
+On the kernel path the peak finish is *fused*: the irfft runs blockwise over
+``lagmax_block`` receiver rows and each block's lag tiles feed a Pallas
+abs-max reduction (``_lag_absmax_kernel``) whose (pairs,) running-max
+accumulator stays resident in VMEM while the grid streams the lag axis — the
+(src_chunk, nall, wlen) lag cube of the old finish never materializes in
+HBM, only one (src_chunk, lagmax_block, wlen) slab at a time, and each lag
+tile is read exactly once.
+
 Below ``PALLAS_MIN_CH`` channels (or on non-TPU backends) an XLA batched
 contraction ``einsum("swf,rwf->srf")`` replaces the kernel — same math,
 also 4-D-free, with the same win_block-streamed accumulation (an unpadded
@@ -77,6 +85,15 @@ _TILE_F = 128           # frequency block (lane-aligned)
 WIN_BLOCK_AUTO = 48
 _WIN_BLOCK_DEFAULT = 32
 
+# Fused peak finish: receiver rows per blockwise irfft + Pallas abs-max pass
+# (the only lag-domain transient is (src_chunk, LAGMAX_BLOCK, wlen)).  The
+# reduction kernel's tiles: _PEAK_TILE_P flattened (src x rcv) pair rows by
+# up to _PEAK_TILE_L lag samples (shrunk to fit short records — see
+# _pallas_lag_absmax), 256x512 f32 = 512 KB x2 pipeline buffers at the cap.
+LAGMAX_BLOCK_DEFAULT = 512
+_PEAK_TILE_P = 256
+_PEAK_TILE_L = 512
+
 
 def _resolve_win_block(nwin: int, win_block: int | None) -> int:
     """Validate and normalize ``win_block`` to a slab size in [1, nwin]."""
@@ -85,6 +102,104 @@ def _resolve_win_block(nwin: int, win_block: int | None) -> int:
     if not win_block:                   # None/0: stream only past the auto cap
         return _WIN_BLOCK_DEFAULT if nwin > WIN_BLOCK_AUTO else max(nwin, 1)
     return max(min(win_block, nwin), 1)
+
+
+def _resolve_lagmax_block(nall: int, use_pallas: bool,
+                          lagmax_block: int | None) -> int:
+    """Normalize ``lagmax_block``: 0 disables the fused finish, None fuses
+    on the kernel path only (the einsum fallback keeps the exact-XLA
+    finish), a positive value forces that receiver-block size."""
+    if lagmax_block is not None and lagmax_block < 0:
+        raise ValueError(
+            f"lagmax_block must be None or >= 0, got {lagmax_block}")
+    if lagmax_block is None:
+        return min(LAGMAX_BLOCK_DEFAULT, nall) if use_pallas else 0
+    return min(lagmax_block, nall)
+
+
+def _lag_absmax_kernel(x, out):
+    """One (pair-tile, lag-tile) step of the running peak-|xcorr| reduction.
+
+    Block shapes: x (Tp, Tl) float32 lag samples, out (Tp, 128) running max.
+    The innermost grid dimension streams the lag axis: the max accumulator
+    tile stays resident in VMEM across lag tiles while the grid pipeline
+    double-buffers the next tile's HBM load against this tile's compute —
+    each lag sample is read from HBM exactly once and nothing lag-shaped is
+    written back.  The per-tile reduction folds the Tl lanes onto a 128-lane
+    running max (static loop, VPU maximums); the final 128 -> 1 fold happens
+    outside on the (pairs, 128) output.  Lag/pair padding is zero-filled by
+    the caller — |.| >= 0, so zeros never win a max over real samples."""
+    lag_step = pl.program_id(1)
+
+    @pl.when(lag_step == 0)
+    def _init():
+        out[:] = jnp.zeros(out.shape, out.dtype)
+
+    a = jnp.abs(x[:])
+    m = a[:, 0:128]
+    for j in range(1, a.shape[1] // 128):
+        m = jnp.maximum(m, a[:, j * 128:(j + 1) * 128])
+    out[:] = jnp.maximum(out[:], m)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def _pallas_lag_absmax(lag: jnp.ndarray, interpret: bool = False):
+    """(npairs, nlag) float32 lag block -> (npairs,) peak |xcorr|, the lag
+    axis streamed through the kernel grid with a VMEM-resident accumulator.
+    Pads both axes with zeros (safe: |.| >= 0) — the lag axis only to the
+    128-lane grain, with the lag tile sized as the largest power-of-two
+    multiple of 128 that divides the padded length (capped at
+    ``_PEAK_TILE_L``), so a short ``wlen`` is not inflated to a full 512
+    tile (8x the real bytes at wlen=64)."""
+    npairs, _ = lag.shape
+    lp = _pad_to(_pad_to(lag, 0, _PEAK_TILE_P), 1, 128)
+    tile_l = 128
+    while tile_l < _PEAK_TILE_L and lp.shape[1] % (tile_l * 2) == 0:
+        tile_l *= 2
+    grid = (lp.shape[0] // _PEAK_TILE_P, lp.shape[1] // tile_l)
+    out = pl.pallas_call(
+        _lag_absmax_kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((_PEAK_TILE_P, tile_l),
+                               lambda i, l: (i, l),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((_PEAK_TILE_P, 128), lambda i, l: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((lp.shape[0], 128), jnp.float32),
+        interpret=interpret,
+    )(lp)
+    return jnp.max(out[:npairs], axis=-1)
+
+
+def _fused_peak_finish(cross, wlen: int, rcv_block: int, interpret: bool):
+    """(m, nall, nf) cross-spectra -> (m, nall) peak |xcorr| without ever
+    materializing the (m, nall, wlen) lag cube: the irfft runs ``rcv_block``
+    receiver rows at a time and each slab reduces through the Pallas abs-max
+    grid before the next slab's transform starts (``lax.map`` keeps exactly
+    one slab live; XLA overlaps slab k+1's irfft with slab k's reduction).
+
+    Callers may opt in from the einsum fallback (``lagmax_block > 0`` with
+    ``use_pallas=False``) — the reduction kernel only lowers on TPU, so on
+    other backends it drops to interpret mode here instead of failing in
+    ``pallas_call``."""
+    interpret = interpret or jax.default_backend() not in ("tpu", "axon")
+    m, nall, nf = cross.shape
+    if rcv_block >= nall:
+        lag = jnp.fft.irfft(cross, n=wlen, axis=-1)
+        return _pallas_lag_absmax(lag.reshape(m * nall, wlen),
+                                  interpret=interpret).reshape(m, nall)
+    pad = (-nall) % rcv_block
+    cp = jnp.pad(cross, ((0, 0), (0, pad), (0, 0)))   # receiver rows, not
+    n_blocks = cp.shape[1] // rcv_block               # the window axis
+    blocks = jnp.moveaxis(cp.reshape(m, n_blocks, rcv_block, nf), 1, 0)
+
+    def one(blk):
+        lag = jnp.fft.irfft(blk, n=wlen, axis=-1)     # (m, rcv_block, wlen)
+        return _pallas_lag_absmax(lag.reshape(m * rcv_block, wlen),
+                                  interpret=interpret).reshape(m, rcv_block)
+
+    peaks = lax.map(one, blocks)                      # (n_blocks, m, rcv_block)
+    return jnp.moveaxis(peaks, 0, 1).reshape(m, -1)[:, :nall]
 
 
 def _spectra_tile_kernel(nwin: int, win_block: int, sr, si, rr, ri, cr, ci):
@@ -307,7 +422,8 @@ def xcorr_all_pairs_peak(data: jnp.ndarray, wlen: int,
                          overlap_ratio: float = 0.5, src_chunk: int = 64,
                          use_pallas: bool | None = None,
                          interpret: bool = False,
-                         win_block: int | None = None) -> jnp.ndarray:
+                         win_block: int | None = None,
+                         lagmax_block: int | None = None) -> jnp.ndarray:
     """Per-pair peak |xcorr| over all lags: (nch, nch) float32.
 
     The fully streamed form for channel counts where even a trimmed lag
@@ -321,16 +437,21 @@ def xcorr_all_pairs_peak(data: jnp.ndarray, wlen: int,
     record-length-invariant; measured by bench.py's nt≈60k entry).
     Auto-enabled past ``WIN_BLOCK_AUTO`` windows to keep the kernel's VMEM
     tiles bounded.
+
+    ``lagmax_block`` controls the fused peak finish (see
+    :func:`peak_from_spectra`): None fuses on the kernel path, 0 forces the
+    unfused XLA finish, a positive value sets the receiver-block size.
     """
     wf = _window_spectra(data, wlen, overlap_ratio)
     use_p = _decide_pallas(wf.shape[0], use_pallas)
     return peak_from_spectra(wf, wf, wlen, src_chunk, use_p, interpret,
-                             win_block=win_block)
+                             win_block=win_block, lagmax_block=lagmax_block)
 
 
 def peak_from_spectra(wf_src, wf_all, wlen: int, src_chunk: int,
                       use_pallas: bool, interpret: bool = False,
-                      win_block: int | None = None):
+                      win_block: int | None = None,
+                      lagmax_block: int | None = None):
     """Peak |xcorr| of every ``wf_src`` row against every ``wf_all`` row:
     (nsrc, nall) float32.  Split out so a sharded caller
     (``parallel.allpairs``) can hand each device its own source-row block
@@ -338,15 +459,27 @@ def peak_from_spectra(wf_src, wf_all, wlen: int, src_chunk: int,
 
     With ``win_block`` (or automatically past ``WIN_BLOCK_AUTO`` windows)
     the window mean accumulates ``win_block`` windows at a time inside the
-    kernel grid; a ragged tail is masked in-kernel, so ``wf_all`` — the
-    largest array of the 10k-channel config, replicated per device under
-    ``parallel.allpairs`` — is never padded or copied along the window axis.
-    Negative ``win_block`` raises ``ValueError``."""
+    kernel grid; a ragged tail is masked in-kernel, so ``wf_all`` — under
+    ``parallel.allpairs``'s ring pipeline the per-device O(nch/D) receiver
+    shard — is never padded or copied along the window axis.  Negative
+    ``win_block`` raises ``ValueError``.
+
+    ``lagmax_block`` (None = fuse on the kernel path, 0 = unfused XLA
+    finish, >0 = that receiver-block size) routes the irfft + |.|-max
+    finish through :func:`_fused_peak_finish`: blockwise irfft + a Pallas
+    lag-streaming max whose accumulator stays VMEM-resident, so the
+    (src_chunk, nall, wlen) lag cube of the unfused finish never exists in
+    HBM.  The einsum fallback keeps the unfused finish by default (exact
+    parity reference).  Negative values raise ``ValueError``."""
     wb = _resolve_win_block(wf_src.shape[1], win_block)
+    lb = _resolve_lagmax_block(wf_all.shape[0], use_pallas, lagmax_block)
     cross = _make_cross_fn(wf_all, use_pallas, interpret, wb)
 
     def finish(src_rows):
-        c = jnp.fft.irfft(cross(src_rows), n=wlen, axis=-1)
-        return jnp.max(jnp.abs(c), axis=-1)
+        c = cross(src_rows)
+        if lb:
+            return _fused_peak_finish(c, wlen, lb, interpret)
+        lag = jnp.fft.irfft(c, n=wlen, axis=-1)
+        return jnp.max(jnp.abs(lag), axis=-1)
 
     return _chunked(wf_src, src_chunk, finish)

@@ -8,6 +8,6 @@ mean stack turns into an XLA all-reduce inserted by pjit.
 
 from das_diff_veh_tpu.parallel.allpairs import sharded_all_pairs_peak  # noqa: F401
 from das_diff_veh_tpu.parallel.distributed import (  # noqa: F401
-    cluster_spec_from_env, initialize_cluster)
+    cluster_spec_from_env, initialize_cluster, ring_perm)
 from das_diff_veh_tpu.parallel.mesh import make_mesh, pad_batch  # noqa: F401
 from das_diff_veh_tpu.parallel.stack import sharded_stack_pipeline  # noqa: F401

@@ -1,48 +1,84 @@
-"""Multi-device all-pairs cross-correlation: source rows sharded over the mesh.
+"""Multi-device all-pairs cross-correlation: a ring pipeline over the mesh.
 
 Scales the BASELINE config-4 workload (synthetic 10k-channel ambient-noise
 all-pairs, the generalization of the reference's XCORR_vshot loop,
-modules/utils.py:289-314) across a device mesh.  The decomposition follows
-the scaling-book recipe: the (nch x nch) pair space splits along the
-*source-row* axis — each device owns ``nch / n_devices`` source rows and
-correlates them against the full receiver set, so the work is embarrassingly
-parallel and the only cross-device traffic is the initial replicated input
-broadcast; no collectives run in the loop (output stays source-sharded for
-any downstream reduction to contract over ICI).
+modules/utils.py:289-314) across a device mesh with O(nch/D) per-device
+memory on BOTH sides of the pair space.
 
-Inside each shard the single-device streaming machinery is reused unchanged
-(``ops.pallas_xcorr``: source-chunk ``lax.map`` + Pallas spectra-tile kernel
-with window-block grid streaming on TPU, exact-f32 einsum elsewhere), so
+The pre-ring decomposition sharded only source rows and replicated the full
+windowed-spectra set on every device — the largest array of the 10k-channel
+config, so per-device memory stayed O(nch) and the engine could not scale
+past one chip's HBM.  The ring decomposition (the ring-attention recipe
+applied to seismic interferometry) removes that ceiling:
+
+- each device keeps its own ``nch/D`` *source* rows AND only ``nch/D``
+  *receiver* spectra — nothing receiver-sided is ever materialized at full
+  width on any device (asserted structurally on the traced jaxpr by
+  tests/test_parallel.py, not just benchmarked);
+- inside a ``shard_map``, D steps correlate the resident source rows against
+  the currently-held receiver shard while ``lax.ppermute`` rotates the
+  shards one neighbor hop around the mesh (``distributed.ring_perm``);
+- the rotation is double-buffered: step k+1's ppermute is issued *before*
+  step k's correlation, so XLA's latency-hiding scheduler overlaps the ICI
+  transfer with the Pallas compute.  The overlap ceiling is
+  ``t_comm/t_compute`` (docs/PERF.md §ring); at all-pairs arithmetic
+  intensity the compute side dominates for any realistic shard size.
+
+Inside each (device, step) the single-device streaming machinery is reused
+unchanged (``ops.pallas_xcorr``: source-chunk ``lax.map`` + Pallas
+spectra-tile kernel with window-block grid streaming on TPU, exact-f32
+einsum elsewhere, fused irfft+lag-max finish on the kernel path), so
 per-device memory stays bounded regardless of channel count AND record
-length.  The receiver-side kernel preparation (planar split + tile padding
-of the replicated full spectra set — the largest array of the 10k-channel
-config) happens once per device, outside the source-chunk loop, and the
-window axis is never zero-padded or copied at all (ragged window tails are
-masked inside the kernel).
+length.  Each step's receiver-side kernel preparation (planar split + tile
+padding) touches only the O(nch/D) resident shard.
+
+A channel count that does not divide the mesh is zero-padded to the next
+device multiple before windowing; padded rows ride the ring like real ones
+(their peaks land in rows/cols that are trimmed from the output), so every
+shard stays the same static shape — no ragged collective.
 
 ``bench.py`` executes this path with ``use_pallas=True`` on the real chip
-(BENCH ``pallas_sharded_*`` entries, with parity against the unsharded
-kernel); the CI tests exercise the same code in interpret mode on the
-8-device CPU mesh.
+(BENCH ``ring_*`` entries, with parity against the unsharded kernel and a
+replicated-vs-ring per-device peak-memory comparison); the CI tests exercise
+the same code in interpret mode on the 8-device CPU mesh, including the
+1-device degenerate ring and ragged channel counts.
 """
 
 from __future__ import annotations
 
 from functools import partial
 
+import jax
 import jax.numpy as jnp
+from jax import lax
+
 try:                                    # jax >= 0.8
     from jax import shard_map
     _NO_VMA_CHECK = {"check_vma": False}
 except ImportError:                     # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
     _NO_VMA_CHECK = {"check_rep": False}    # same knob, pre-0.8 spelling
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from das_diff_veh_tpu.config import RingConfig
 from das_diff_veh_tpu.ops.pallas_xcorr import (_decide_pallas,
+                                               _resolve_lagmax_block,
                                                _resolve_win_block,
                                                _window_spectra,
                                                peak_from_spectra)
+from das_diff_veh_tpu.parallel.distributed import ring_perm
+
+
+@partial(jax.jit, static_argnames=("wlen", "overlap_ratio", "spec"))
+def _sharded_window_spectra(data, wlen: int, overlap_ratio: float, spec):
+    """Windowed spectra with their channel rows pinned to ``spec`` (the
+    mesh's source-row sharding).  Jitted so an *eager* caller never
+    materializes the full (nch, nwin, nf) set on one device — GSPMD places
+    the row-parallel window/rfft work shard-by-shard under the constraint;
+    under an outer jit the constraint simply propagates.  Without this,
+    the O(nch/D) per-device claim would only hold for jitted callers."""
+    return lax.with_sharding_constraint(
+        _window_spectra(data, wlen, overlap_ratio), spec)
 
 
 def sharded_all_pairs_peak(data: jnp.ndarray, wlen: int, mesh: Mesh, *,
@@ -50,37 +86,103 @@ def sharded_all_pairs_peak(data: jnp.ndarray, wlen: int, mesh: Mesh, *,
                            src_chunk: int = 64,
                            use_pallas: bool | None = None,
                            interpret: bool = False,
-                           win_block: int | None = None) -> jnp.ndarray:
-    """Per-pair peak |xcorr| (nch, nch) computed with source rows sharded
-    over ``mesh``'s ``axis``.  Matches ``xcorr_all_pairs_peak`` exactly
-    (parity-tested on the CI 8-device CPU mesh).
+                           win_block: int | None = None,
+                           ring: RingConfig | None = None) -> jnp.ndarray:
+    """Per-pair peak |xcorr| (nch, nch) computed as a ring pipeline over
+    ``mesh``'s ``axis``.  On the kernel path this matches
+    ``xcorr_all_pairs_peak`` bit-for-bit — the in-kernel window
+    accumulation order is fixed regardless of shard shape (parity-tested
+    on the CI 8-device CPU mesh, ragged nch included); the einsum fallback
+    agrees to dot_general reduction-order tolerance (~1e-7 relative).
 
     ``data``: (nch, nt) replicated; rows are zero-padded to a device-count
-    multiple and the padding is trimmed from the output.
+    multiple and the padding is trimmed from the output.  ``ring`` selects
+    the decomposition (``RingConfig.mode``): the default ``"ring"`` keeps
+    O(nch/D) receiver spectra per device; ``"replicated"`` restores the
+    pre-ring full-set broadcast for A/B memory benchmarking.
     """
-    _resolve_win_block(1, win_block)    # validate before any device work
+    ring = RingConfig() if ring is None else ring
+    if ring.mode not in ("ring", "replicated"):
+        raise ValueError(f"RingConfig.mode must be 'ring' or 'replicated', "
+                         f"got {ring.mode!r}")
+    _resolve_win_block(1, win_block)        # validate before any device work
+    _resolve_lagmax_block(1, False, ring.lagmax_block)
     nch = data.shape[0]
     n_dev = mesh.shape[axis]
     pad = (-nch) % n_dev
     dpad = jnp.pad(data, ((0, pad), (0, 0)))
+    shard_rows = (nch + pad) // n_dev
     # decide on the PER-DEVICE workload: each shard correlates nch/n_dev
-    # source rows (not nch) against the full set, and the kernel-vs-einsum
-    # crossover tracks the smaller source-tile axis
-    use_p = _decide_pallas((nch + pad) // n_dev, use_pallas)
-    # windowed spectra once, outside the shard: each device then receives its
-    # source-row slice plus the replicated full set (recomputing inside the
-    # shard would run the full-set rfft n_dev times)
-    wf = _window_spectra(dpad, wlen, overlap_ratio)
+    # source rows against nch/n_dev-row receiver shards, and the
+    # kernel-vs-einsum crossover tracks the smaller tile axis
+    use_p = _decide_pallas(shard_rows, use_pallas)
+    # windowed spectra once, outside the shard (recomputing inside would run
+    # the rfft n_dev times), with the row sharding constrained to the mesh —
+    # the full set never lands on any single device, eager callers included
+    wf = _sharded_window_spectra(dpad, wlen, overlap_ratio,
+                                 NamedSharding(mesh, P(axis, None, None)))
 
-    # vma/rep checking off: the body is collective-free (each device works on
-    # its own source rows), and jax's varying-mesh-axes validation cannot see
-    # through pallas_call's out_shape (it would demand explicit vma tags)
-    @partial(shard_map, mesh=mesh,
-             in_specs=(P(axis, None, None), P(None, None, None)),
+    kernel_kw = dict(win_block=win_block, lagmax_block=ring.lagmax_block)
+
+    if ring.mode == "replicated":
+        # pre-ring layout: full receiver set broadcast to every device, no
+        # collectives in the loop.  O(nch) per-device memory — kept for the
+        # bench's replicated-vs-ring peak-bytes comparison and for
+        # single-chip meshes where the "broadcast" is the resident copy.
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(axis, None, None), P(None, None, None)),
+                 out_specs=P(axis, None), **_NO_VMA_CHECK)
+        def run_replicated(wf_src, wf_all):
+            return peak_from_spectra(wf_src, wf_all, wlen, src_chunk, use_p,
+                                     interpret, **kernel_kw)
+
+        return run_replicated(wf, wf)[:nch, :nch]
+
+    perm = ring_perm(n_dev)
+
+    # vma/rep checking off: the body's only collective is the neighbor
+    # ppermute (uniform across devices), and jax's varying-mesh-axes
+    # validation cannot see through pallas_call's out_shape (it would
+    # demand explicit vma tags)
+    @partial(shard_map, mesh=mesh, in_specs=(P(axis, None, None),),
              out_specs=P(axis, None), **_NO_VMA_CHECK)
-    def run(wf_src, wf_all):
-        return peak_from_spectra(wf_src, wf_all, wlen, src_chunk, use_p,
-                                 interpret, win_block=win_block)
+    def run_ring(wf_local):
+        me = lax.axis_index(axis)
+        m = wf_local.shape[0]
 
-    out = run(wf, wf)
-    return out[:nch, :nch]
+        # one traced step body (fori_loop, not a Python unroll): program
+        # size stays O(1) in the device count — a pod-scale mesh would
+        # otherwise inline D copies of the whole kernel pipeline.  The
+        # trade: every step rotates, so the final step sends one shard
+        # nobody reads (overlapped with its compute; negligible vs a
+        # D-times-larger HLO).
+        def step(k, carry):
+            rcv, out = carry
+            if ring.double_buffer:
+                # issue the rotation BEFORE this step's correlation: the
+                # two depend only on rcv, so XLA overlaps the collective-
+                # permute-start/done pair with the compute between them
+                nxt = lax.ppermute(rcv, axis, perm)
+                blk = peak_from_spectra(wf_local, rcv, wlen, src_chunk,
+                                        use_p, interpret, **kernel_kw)
+            else:
+                # profiling mode: gate the rotation on the finished
+                # correlation so transfer and compute truly serialize —
+                # without the barrier both orderings trace to the same
+                # dependency graph and the scheduler overlaps them anyway
+                blk = peak_from_spectra(wf_local, rcv, wlen, src_chunk,
+                                        use_p, interpret, **kernel_kw)
+                gated, _ = lax.optimization_barrier((rcv, blk))
+                nxt = lax.ppermute(gated, axis, perm)
+            # the shard held at step k started on device (me + k) % D, so
+            # its peaks are the output columns of that device's global rows
+            col = ((me + k) % n_dev) * shard_rows
+            out = lax.dynamic_update_slice(out, blk,
+                                           (jnp.zeros_like(col), col))
+            return nxt, out
+
+        out0 = jnp.zeros((m, n_dev * shard_rows), jnp.float32)
+        _, out = lax.fori_loop(0, n_dev, step, (wf_local, out0))
+        return out
+
+    return run_ring(wf)[:nch, :nch]

@@ -22,6 +22,23 @@ import os
 from typing import Optional
 
 
+def ring_perm(n_dev: int, shift: int = 1) -> list:
+    """``lax.ppermute`` permutation for one ring rotation over ``n_dev``
+    mesh slots: device j sends to device ``(j - shift) % n_dev``, so after
+    one application device i holds the shard that started on device
+    ``(i + shift) % n_dev``.
+
+    Centralized here because the rotation direction is a *placement*
+    concern: ``make_mesh`` lays devices out in ``jax.devices()`` order, so
+    on a TPU slice consecutive mesh slots are ICI neighbors within a host
+    and the single cross-host hop rides DCN — the same nearest-neighbor
+    traffic pattern whether the mesh spans one host or many
+    (``initialize_cluster`` above).  Every ring step moves each shard
+    exactly one hop; no step ever needs all-to-all bandwidth.
+    """
+    return [(j, (j - shift) % n_dev) for j in range(n_dev)]
+
+
 def cluster_spec_from_env(env: Optional[dict] = None):
     """(coordinator_address, num_processes, process_id) from the environment.
 

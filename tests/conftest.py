@@ -22,9 +22,51 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 from das_diff_veh_tpu.cache import enable_compilation_cache  # noqa: E402
 
 jax.config.update("jax_enable_x64", True)
 jax.config.update("jax_platforms", "cpu")
 enable_compilation_cache(_REPO)
+
+
+# --------------------------------------------------------------------------
+# the canonical real-compute scene, shared session-wide
+#
+# A full ``process_chunk`` trace costs ~40 s on this host's single CPU core
+# and the tier-1 budget is 870 s, so every test that needs a REAL pipeline
+# run must reuse one scene geometry + one PipelineConfig: the jit cache
+# then compiles the program once per session and every later caller
+# (including the serving engine, whose config hash feeds its bucket cache)
+# is a cache hit.  Tests that need different physics knobs should stub the
+# compute instead (tests/test_serve.py's FnComputeFactory pattern).
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="session")
+def pipeline_scene():
+    """(section, truth) of the canonical small synthetic scene."""
+    from das_diff_veh_tpu.io.synthetic import SceneConfig, synthesize_section
+
+    return synthesize_section(SceneConfig(nch=100, duration=120.0,
+                                          n_vehicles=4, seed=11,
+                                          speed_range=(12.0, 18.0)))
+
+
+@pytest.fixture(scope="session")
+def pipeline_cfg():
+    """The PipelineConfig every real process_chunk test runs under."""
+    from das_diff_veh_tpu.config import ImagingConfig, PipelineConfig
+
+    return PipelineConfig().replace(imaging=ImagingConfig(x0=400.0))
+
+
+@pytest.fixture(scope="session")
+def chunk_result_xcorr(pipeline_scene, pipeline_cfg):
+    """``process_chunk`` compiled and executed ONCE per session on the
+    canonical scene; consumers assert against this shared result instead
+    of tracing their own variant."""
+    from das_diff_veh_tpu.pipeline.timelapse import process_chunk
+
+    section, _ = pipeline_scene
+    return process_chunk(section, pipeline_cfg, method="xcorr")

@@ -1,0 +1,64 @@
+"""Structural jaxpr assertions shared by the kernel and parallel tests.
+
+These pin *program structure*, not numbers: the streaming/sharding claims
+of ``ops.pallas_xcorr`` and ``parallel.allpairs`` (no window-axis padding,
+no receiver-set broadcast) are asserted on the traced jaxpr so a regression
+fails in tier-1 on CPU, not only as a memory blow-up on the chip.
+"""
+
+import jax
+
+
+def iter_eqns(jaxpr):
+    """Yield every equation of ``jaxpr``, recursing through the sub-jaxprs
+    carried in equation params (scan/pjit/cond/shard_map/...)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for p in eqn.params.values():
+            for j in (p if isinstance(p, (list, tuple)) else [p]):
+                if isinstance(j, jax.core.ClosedJaxpr):
+                    yield from iter_eqns(j.jaxpr)
+                elif isinstance(j, jax.core.Jaxpr):
+                    yield from iter_eqns(j)
+
+
+def window_axis_pads(closed_jaxpr, nwin):
+    """Every pad equation that grows axis 1 of a rank-3 spectra-shaped
+    operand with ``nwin`` windows — i.e. a zero-padded window-axis copy of
+    a spectra array (the thing the win_block streaming exists to avoid)."""
+    found = []
+    for eqn in iter_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name == "pad":
+            src, dst = eqn.invars[0].aval, eqn.outvars[0].aval
+            if (len(src.shape) == 3 and src.shape[1] == nwin
+                    and dst.shape[1] != nwin):
+                found.append(eqn)
+    return found
+
+
+def collective_eqns(closed_jaxpr, names=("all_gather", "all_to_all")):
+    """Equations whose primitive is one of the named collectives, anywhere
+    in the program (shard_map bodies included)."""
+    return [e for e in iter_eqns(closed_jaxpr.jaxpr)
+            if e.primitive.name in names]
+
+
+def shard_body_full_set_avals(closed_jaxpr, n_full, nwin):
+    """Equations *inside a shard_map body* that bind a rank-3 value shaped
+    like the FULL receiver spectra set — (n_full, nwin, ...) — i.e. a
+    per-device materialization of all ``n_full`` channels' windowed
+    spectra.  The ring decomposition's O(nch/D) memory claim holds iff this
+    is empty; the replicated layout trips it by construction (which is how
+    the checker itself is validated)."""
+    found = []
+    for eqn in iter_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name != "shard_map":
+            continue
+        body = eqn.params.get("jaxpr")
+        body = body.jaxpr if hasattr(body, "jaxpr") else body
+        for var in list(body.invars) + [
+                v for e in iter_eqns(body) for v in e.outvars]:
+            shape = getattr(var.aval, "shape", ())
+            if len(shape) == 3 and shape[0] == n_full and shape[1] == nwin:
+                found.append(var)
+    return found

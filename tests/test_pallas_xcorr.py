@@ -156,34 +156,13 @@ def test_negative_win_block_rejected():
         xcorr_all_pairs(d, 64, use_pallas=False, win_block=-1)
 
 
-def _window_axis_pads(closed_jaxpr, nwin):
-    """Every pad equation (recursively, through scan/pjit/cond sub-jaxprs)
-    that grows axis 1 of a rank-3 spectra-shaped operand with ``nwin``
-    windows — i.e. a zero-padded window-axis copy of a spectra array."""
-    found = []
-
-    def walk(jaxpr):
-        for eqn in jaxpr.eqns:
-            if eqn.primitive.name == "pad":
-                src, dst = eqn.invars[0].aval, eqn.outvars[0].aval
-                if (len(src.shape) == 3 and src.shape[1] == nwin
-                        and dst.shape[1] != nwin):
-                    found.append(eqn)
-            for p in eqn.params.values():
-                for j in (p if isinstance(p, (list, tuple)) else [p]):
-                    if isinstance(j, jax.core.ClosedJaxpr):
-                        walk(j.jaxpr)
-                    elif isinstance(j, jax.core.Jaxpr):
-                        walk(j)
-
-    walk(closed_jaxpr.jaxpr)
-    return found
-
-
 def test_no_window_axis_pad_in_blocked_paths():
     """Acceptance: no full zero-padded copy of wf_all (or wf_src) along the
     window axis remains in the blocked path — asserted on the traced
-    program of both the einsum and the Pallas variants."""
+    program of both the einsum and the Pallas variants (the walker lives in
+    jaxpr_checks.py, shared with the parallel no-broadcast pins)."""
+    from jaxpr_checks import window_axis_pads
+
     d = _data(nch=10, nt=900)           # 27 windows, win_block 8: ragged
     wlen = 64
     wf = _window_spectra(d, wlen, 0.5)
@@ -195,7 +174,7 @@ def test_no_window_axis_pad_in_blocked_paths():
             lambda ws, wa: peak_from_spectra(ws, wa, wlen, 4, use_pallas,
                                              interpret=True, win_block=8)
         )(wf, wf)
-        pads = _window_axis_pads(jx, nwin)
+        pads = window_axis_pads(jx, nwin)
         assert not pads, f"window-axis pad survives (pallas={use_pallas}): {pads}"
 
 
@@ -232,6 +211,45 @@ def test_long_record_streamed_bench_scale():
                                                  win_block=10 ** 6,
                                                  src_chunk=16))
     np.testing.assert_allclose(peak, unstreamed, rtol=2e-5, atol=1e-6)
+
+
+def test_fused_lagmax_matches_unfused_bitwise():
+    """The fused peak finish (blockwise irfft + Pallas lag-streaming
+    abs-max) must equal the unfused XLA finish bit-for-bit on identical
+    cross-spectra — max is order-independent and the row-wise irfft is the
+    same transform, so any drift here is a real kernel bug.  Covers the
+    single-pass (block >= nall), blocked-even, and blocked-ragged
+    (nall % block != 0) shapes."""
+    d = _data(nch=10, nt=900)
+    wlen = 64
+    unfused = np.asarray(xcorr_all_pairs_peak(d, wlen, use_pallas=True,
+                                              interpret=True, src_chunk=4,
+                                              lagmax_block=0))
+    for lb in (None, 4, 5, 100):        # auto, ragged, even-ish, >= nall
+        fused = np.asarray(xcorr_all_pairs_peak(d, wlen, use_pallas=True,
+                                                interpret=True, src_chunk=4,
+                                                lagmax_block=lb))
+        np.testing.assert_array_equal(fused, unfused)
+
+
+def test_fused_lagmax_einsum_path_opt_in():
+    """lagmax_block > 0 fuses the finish on the einsum fallback too (the
+    default there stays the exact XLA finish), and works WITHOUT the
+    caller passing interpret: the reduction kernel only lowers on TPU, so
+    on other backends the fused finish drops to interpret mode itself
+    instead of failing in pallas_call."""
+    d = _data(nch=9, nt=700)
+    wlen = 64
+    want = np.asarray(xcorr_all_pairs_peak(d, wlen, use_pallas=False))
+    got = np.asarray(xcorr_all_pairs_peak(d, wlen, use_pallas=False,
+                                          lagmax_block=4))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_negative_lagmax_block_rejected():
+    d = _data(nch=6, nt=300)
+    with pytest.raises(ValueError, match="lagmax_block"):
+        xcorr_all_pairs_peak(d, 64, use_pallas=False, lagmax_block=-1)
 
 
 def test_pallas_peak_interpret():

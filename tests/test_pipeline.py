@@ -4,25 +4,24 @@ import pytest
 from das_diff_veh_tpu.config import ImagingConfig, PipelineConfig
 from das_diff_veh_tpu.core.section import DasSection
 from das_diff_veh_tpu.io.readers import save_section_npz
-from das_diff_veh_tpu.io.synthetic import SceneConfig, synthesize_section
 from das_diff_veh_tpu.pipeline.timelapse import process_chunk
 from das_diff_veh_tpu.pipeline.workflow import date_range, run_date_range
 
 
 @pytest.fixture(scope="module")
-def scene():
-    cfg = SceneConfig(nch=100, duration=120.0, n_vehicles=4, seed=11,
-                      speed_range=(12.0, 18.0))
-    return synthesize_section(cfg)
+def scene(pipeline_scene):
+    """Alias of the session-scoped canonical scene (conftest.py): every
+    process_chunk trace in this module reuses the shared geometry, so the
+    ~40 s compile happens once per session, not once per module."""
+    return pipeline_scene
 
 
 def _cfg(x0=400.0):
     return PipelineConfig().replace(imaging=ImagingConfig(x0=x0))
 
 
-def test_process_chunk_xcorr(scene):
-    section, truth = scene
-    res = process_chunk(section, _cfg(), method="xcorr")
+def test_process_chunk_xcorr(chunk_result_xcorr):
+    res = chunk_result_xcorr
     assert res.n_windows >= 1
     img = np.asarray(res.disp_image)
     assert img.shape == (1000, 242)

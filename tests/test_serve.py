@@ -2,9 +2,10 @@
 
 Dispatcher behavior is driven with injected stub compute factories
 (``FnComputeFactory``) so tier-1 never traces ``process_chunk`` on a new
-shape; the one real-compute case reuses a single small geometry and runs
-``process_chunk`` exactly twice (engine vs direct) to pin bit-exactness of
-the pad -> compute -> unpad round trip on the production path.
+shape; the one real-compute case pins bit-exactness of the pad -> compute
+-> unpad round trip on the production path against the session-scoped
+``chunk_result_xcorr`` fixture (conftest.py), adding ONE jit-cache-hit
+execution and zero compiles of its own.
 """
 
 import json
@@ -16,8 +17,7 @@ import urllib.request
 import numpy as np
 import pytest
 
-from das_diff_veh_tpu.config import (ImagingConfig, PipelineConfig,
-                                     ServeConfig, TrackingConfig)
+from das_diff_veh_tpu.config import PipelineConfig, ServeConfig
 from das_diff_veh_tpu.core.section import DasSection
 from das_diff_veh_tpu.runtime import load_trace, make_tracer
 from das_diff_veh_tpu.serve import (DeadlineExceededError, EngineClosedError,
@@ -432,26 +432,22 @@ def test_cli_batch_compilation_cache_flag():
 # the one real-compute case: production path bit-exactness
 # --------------------------------------------------------------------------
 
-@pytest.fixture(scope="module")
-def small_scene():
-    from das_diff_veh_tpu.io.synthetic import SceneConfig, synthesize_section
-    cfg = SceneConfig(nch=100, duration=60.0, n_vehicles=2, seed=11,
-                      speed_range=(12.0, 18.0))
-    return synthesize_section(cfg)
-
-
-def test_real_imaging_engine_bit_exact(small_scene):
+def test_real_imaging_engine_bit_exact(pipeline_scene, pipeline_cfg,
+                                       chunk_result_xcorr):
     """Engine round trip on the production ``process_chunk`` path equals the
     direct call bit-for-bit, and the session accumulator matches the batch
-    workflow's semantics.  One small geometry, reduced static capacities,
-    exactly two process_chunk executions."""
-    from das_diff_veh_tpu.pipeline.timelapse import process_chunk
-    section, _ = small_scene
-    pcfg = PipelineConfig().replace(
-        imaging=ImagingConfig(x0=400.0), max_windows=4,
-        tracking=TrackingConfig(max_vehicles=8))
+    workflow's semantics.
+
+    Every piece is the session-scoped canonical fixture set (conftest.py):
+    the direct reference is ``chunk_result_xcorr`` — already compiled and
+    executed for the pipeline tests — and the engine runs the SAME config
+    and bucket shape, so its one execution is a jit-cache hit.  This test
+    traces nothing of its own (a private scene/config here used to pay its
+    own ~40 s process_chunk compile on top of the shared one)."""
+    section, _ = pipeline_scene
     shape = tuple(int(s) for s in section.data.shape)
-    factory = ImagingComputeFactory(pcfg, method="xcorr", x_is_channels=False,
+    factory = ImagingComputeFactory(pipeline_cfg, method="xcorr",
+                                    x_is_channels=False,
                                     x_axis=np.asarray(section.x), fs=250.0)
     eng = ServingEngine(factory, ServeConfig(
         buckets=(shape,), warmup=False, default_deadline_ms=600000.0)).start()
@@ -462,7 +458,7 @@ def test_real_imaging_engine_bit_exact(small_scene):
                           session="fiber", timeout=600)
     finally:
         eng.close()
-    direct = process_chunk(section, pcfg, method="xcorr", x_is_channels=False)
+    direct = chunk_result_xcorr
     assert res.n_windows == int(direct.n_windows) >= 1
     assert np.array_equal(res.image, np.asarray(direct.disp_image))
     assert res.valid == res.bucket == shape and not res.padded
