@@ -28,7 +28,12 @@ entry (``serve_*`` keys) drives an open-loop variable-shape request load
 through naive per-request execution vs the microbatched shape-bucketed
 serving engine (``das_diff_veh_tpu.serve``), reporting p50/p99 latency and
 req/s for both plus the engine's steady-state compile count (asserted 0);
-BENCH_SERVE_REQS/SHAPES/INTERARRIVAL_MS/NCH/NT tune the load.  Opt-outs:
+BENCH_SERVE_REQS/SHAPES/INTERARRIVAL_MS/NCH/NT tune the load.  A
+trajectory-gather stage entry (``stage_gather_traj_*`` keys) times the
+fused Pallas scalar-prefetch window cut against the legacy serialized
+vmap(dynamic_slice) formulation at the pipeline's far-side shape
+(BENCH_GATHER_K sets the in-dispatch K, floor 5; off-TPU the fused side
+runs in interpret mode and is labeled parity-evidence-only).  Opt-outs:
 BENCH_SKIP_E2E / BENCH_SKIP_SERVE / BENCH_SKIP_PALLAS / BENCH_SKIP_SHARDED /
 BENCH_SKIP_LONG / BENCH_SKIP_10K; BENCH_10K_SRC_CHUNK tunes the 10k
 source-chunk size (default 32 — see docs/PERF.md on the working-set effect).
@@ -197,6 +202,58 @@ def main() -> None:
                                       -150.0, 0.0),
         lambda s, i: jnp.roll(s, i, axis=0), stack0, img_shape)
 
+    # trajectory-following gather: fused Pallas scalar-prefetch kernel vs
+    # the legacy serialized vmap(dynamic_slice) window cut, measured on the
+    # pipeline's far-side shape (one window's worth of per-channel
+    # data-dependent cuts, K >= 5 in-dispatch executions).  On CPU smoke
+    # runs the fused kernel executes in INTERPRET mode (a compiled grid
+    # emulation), so its time there is a correctness artifact, not hardware
+    # evidence — the committed smoke carries the keys + the parity number
+    # and is labeled as such in docs/PERF.md; TPU numbers land with the
+    # next driver run under the same keys.  The fused timing is
+    # fault-isolated so a kernel lowering issue surfaces as an *_error key
+    # instead of killing the sweep.
+    from das_diff_veh_tpu.ops import xcorr as XC
+
+    gather_k = max(5, int(os.environ.get("BENCH_GATHER_K", 8)))
+    d_one, t_one = batch.data[0], batch.t[0]
+    # the full gather span's channels against the pivot — one window's
+    # worth of per-channel data-dependent cuts at the pipeline geometry
+    traj_ch = jnp.arange(g.start_x_idx, g.end_x_idx)
+    traj_t = jnp.linspace(float(t_one[0]) + 1.0, float(t_one[-1]) - 1.0,
+                          int(traj_ch.size))
+
+    def traj_stage(mode):
+        return lambda d: XC.xcorr_traj_follow(d, t_one, g.pivot_idx, traj_ch,
+                                              traj_t, g.nsamp, g.wlen,
+                                              mode=mode)
+
+    perturb_rec = lambda d, i: jnp.roll(d, i, axis=0)
+    traj_acc = (int(traj_ch.size), g.wlen)
+    t_traj_serial = amortized_time(traj_stage("serialized"), perturb_rec,
+                                   d_one, traj_acc, k=gather_k)
+    extra_gather = {
+        "stage_gather_traj_rows": int(traj_ch.size),
+        "stage_gather_traj_k": gather_k,
+        "stage_gather_traj_serialized_s": round(t_traj_serial, 5),
+    }
+    try:
+        t_traj_fused = amortized_time(traj_stage("fused"), perturb_rec,
+                                      d_one, traj_acc, k=gather_k)
+        parity_traj = float(jnp.max(jnp.abs(
+            traj_stage("fused")(d_one) - traj_stage("serialized")(d_one))))
+        extra_gather["stage_gather_traj_fused_s"] = round(t_traj_fused, 5)
+        extra_gather["stage_gather_traj_speedup"] = round(
+            t_traj_serial / t_traj_fused, 3)
+        extra_gather["stage_gather_traj_parity_max_abs_diff"] = parity_traj
+    except Exception as e:  # noqa: BLE001 — disclosed, never fatal
+        extra_gather["stage_gather_traj_fused_error"] = \
+            f"{type(e).__name__}: {e}"[:300]
+    if jax.default_backend() not in ("tpu", "axon"):
+        extra_gather["stage_gather_traj_note"] = (
+            "fused timed in interpret mode on this backend — parity "
+            "evidence only, not a hardware speedup")
+
     # --- BASELINE config 2: multi-class stacked dispersion images -------------
     # (vmap over vehicle class: 3 class batches image in ONE device program,
     # the save_disp_imgs per-class loop of imaging_diff_*.ipynb cell 21)
@@ -264,6 +321,7 @@ def main() -> None:
         "stage_gather_stack_s": round(stage_gather, 5),   # device-time budget
         "stage_disp_image_s": round(stage_image, 5),      # of one build
         "stage_disp_image_phase_shift_s": round(stage_image_ps, 5),
+        **extra_gather,
         "multiclass_image_amortized_s": round(t_cls, 5),      # config 2
         "timelapse_chunk_amortized_s": round(t_chunk, 5),     # config 3
         "timelapse_24h_equiv_s": round(t_chunk * chunks_per_day, 2),

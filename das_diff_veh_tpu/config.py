@@ -135,6 +135,33 @@ class GatherConfig:
                                       # (reference end_x = x0 + 75, notebook
                                       # save_disp_imgs / bootstrap geometry)
 
+    traj_gather: str = "auto"
+    """Window-cut engine for the trajectory-following correlations
+    (``ops.xcorr.xcorr_traj_follow``).  ``"serialized"``: the legacy vmapped
+    ``dynamic_slice`` cut — an O(nch) serialized slice chain on TPU, the
+    pipeline's measured hottest op (docs/PERF.md).  ``"fused"``: the Pallas
+    scalar-prefetch gather kernel (``ops.pallas_gather``) — per-channel
+    window starts ride a prefetched scalar operand so one kernel sweep cuts
+    every channel's window at its own offset (interpret-mode fallback
+    off-TPU).  ``"auto"``: fused on TPU backends when the shape is in the
+    kernel's bounds (``ops.pallas_gather.fused_supported``: nwin within the
+    per-step unroll cap, dot-finish VMEM budget), serialized elsewhere —
+    an out-of-bounds shape on TPU silently takes the serialized path
+    rather than erroring.  Execution knob, not physics: fused/serialized
+    parity is pinned at the oracle bar (<= 1e-7) by
+    tests/test_pallas_gather.py."""
+
+    traj_gather_finish: str = "rfft"
+    """Correlate finish of the fused gather path.  ``"rfft"`` (default):
+    the kernel emits packed window tensors and the batched-rfft circular
+    correlate finishes outside — numerically the serialized path with the
+    cut swapped out.  ``"dot"``: the circular correlation finishes
+    in-kernel as an MXU dot against the doubled source-window matrix
+    (small windows only: ``wlen <= ops.pallas_gather.DOT_MAX_WLEN`` and
+    ``nwin*wlen^2 <= DOT_MAX_MATRIX_ELEMS``, the joint VMEM budget of the
+    in-kernel matrix; time-domain float rounding applies, see tests for
+    the pinned tolerance)."""
+
 
 @dataclass(frozen=True)
 class DispersionConfig:

@@ -122,7 +122,9 @@ def build_gather(data: jnp.ndarray, t_axis: jnp.ndarray, x_axis: jnp.ndarray,
     far_ch = jnp.arange(g.pivot_idx + 1, g.end_x_idx)
     far_t = arrival(x[far_ch]) + cfg.delta_t
     far = xc.xcorr_traj_follow(d, t_axis, g.pivot_idx, far_ch, far_t,
-                               g.nsamp, g.wlen, cfg.overlap_ratio)
+                               g.nsamp, g.wlen, cfg.overlap_ratio,
+                               mode=cfg.traj_gather,
+                               finish=cfg.traj_gather_finish)
     main = _postprocess(jnp.concatenate([near, far], axis=0), g,
                         cfg.norm, cfg.norm_amp, reverse=False)
     if not cfg.include_other_side:
@@ -137,7 +139,9 @@ def build_gather(data: jnp.ndarray, t_axis: jnp.ndarray, x_axis: jnp.ndarray,
     left_ch = jnp.arange(g.start_x_idx, g.pivot_idx)
     left_t = arrival(x[left_ch]) - cfg.delta_t
     left = xc.xcorr_traj_follow(d, t_axis, g.pivot_idx, left_ch, left_t,
-                                g.nsamp, g.wlen, cfg.overlap_ratio, reverse=True)
+                                g.nsamp, g.wlen, cfg.overlap_ratio,
+                                reverse=True, mode=cfg.traj_gather,
+                                finish=cfg.traj_gather_finish)
     other = _postprocess(jnp.concatenate([left, right], axis=0), g,
                          cfg.norm, cfg.norm_amp, reverse=True)
 

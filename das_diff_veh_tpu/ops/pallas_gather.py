@@ -1,0 +1,324 @@
+"""Pallas scalar-prefetch gather kernel: the trajectory-following window cut
+fused into the correlate pass.
+
+The paper's centerpiece gather cuts a *per-channel, data-dependent* time
+window for every channel between pivot and vehicle
+(``ops.xcorr.xcorr_traj_follow``): channel ``ch_indices[k]`` and the pivot
+trace are both sliced at ``dt_idx[k]`` (an ``argmax`` over the vehicle's
+arrival times), windowed, and circularly correlated.  The legacy
+formulation vmaps a ``lax.dynamic_slice`` with traced starts over channels;
+XLA lowers that to a *serialized* chain of contiguous block copies on TPU —
+docs/PERF.md measured it as the pipeline's hottest op (2.4 ms for 288 rows
+at the reference shape, already the fastest XLA formulation; the win left
+is doing the cut inside one kernel sweep).
+
+Here the per-channel starts become a **scalar-prefetched operand** of a
+Pallas kernel (``pltpu.PrefetchScalarGridSpec``): the grid runs one step
+per output channel, and each step's ``index_map`` uses the prefetched
+``(row, block)`` indices to DMA that channel's (and the pivot's) spectra
+tile straight from HBM at its own offset — the DMAs double-buffer across
+grid steps instead of serializing, and the element-granular residue of the
+start is applied *inside* the kernel with a dynamic slice of the
+VMEM-resident tile.  Because Pallas block indexing is block-granular, the
+record is reshaped to ``(nch, nblk, G)`` blocks of grain
+``G = roundup(nsamp, 128)`` and each step loads the TWO adjacent blocks
+that cover ``[start, start + nsamp)`` for any in-range start
+(``rem < G`` and ``(nwin-1)*offset + wlen <= nsamp <= G``).
+
+Two finishes, selected by ``GatherConfig.traj_gather_finish``:
+
+- ``"rfft"`` (default): the kernel emits the packed ``(nk, nwin, wlen)``
+  window tensors for channel and pivot (invalid windows zeroed — exactly
+  the windows ``_masked_window_specs``'s validity mask would discard) and
+  the existing batched-rfft circular correlate finishes outside.  Valid
+  windows are bitwise-identical copies of the record, so this path is
+  numerically the legacy path with the serialized cut swapped out.
+- ``"dot"``: for small ``wlen`` (<= ``DOT_MAX_WLEN``) the circular
+  correlation itself finishes in-kernel as an MXU dot against the doubled
+  source-window matrix (``c[k] = sum_n s2[n+k] r[n]`` with
+  ``s2 = [s, s]``), so nothing window-shaped ever leaves the kernel —
+  the output is the final ``(nk, wlen)`` correlation rows.  Time-domain
+  vs FFT float error applies (see tests for the pinned tolerance).
+
+Off-TPU the kernel drops to interpret mode (same convention as
+``ops.pallas_xcorr``), so CPU CI exercises the identical program.
+
+Reference-parity semantics (numpy truncation / backward empty slice) are
+carried by the same ``avail`` arithmetic as ``_masked_window_specs``; the
+validity masks are applied in-kernel.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Compile-time bound on the static per-step window unroll: the imaging
+# gathers cut ~3-15 windows (nwin = (nsamp - wlen)//offset + 1); past this
+# the unrolled in-kernel cut would bloat the kernel body, so ``mode="auto"``
+# falls back to the serialized path (continuous-record window counts belong
+# to the all-pairs engine, not the per-vehicle gather).
+FUSED_MAX_NWIN = 64
+
+# The "dot" finish materializes the (nwin, wlen, wlen) doubled-window
+# matrix in VMEM, so the budget is JOINT in nwin and wlen: wlen is capped
+# per-axis (the unrolled slice count) and nwin*wlen^2 against a ~4 MB f32
+# element budget (2^20 elements = 15 windows at wlen 256; a larger nwin
+# passes only with a proportionally smaller wlen).  The reference wlen of
+# 500 samples stays on the rfft finish either way.
+DOT_MAX_WLEN = 256
+DOT_MAX_MATRIX_ELEMS = 1 << 20
+
+_LANE = 128
+
+
+def _round_up(n: int, mult: int) -> int:
+    return -(-n // mult) * mult
+
+
+def _resolve_interpret(interpret: bool | None) -> bool:
+    if interpret is None:
+        return jax.default_backend() not in ("tpu", "axon")
+    return bool(interpret)
+
+
+def _cut_windows(row, rem, avail, nwin: int, wlen: int, offset: int):
+    """Cut ``nwin`` overlapping windows from the (1, 2G) VMEM-resident row
+    at element offsets ``rem + w*offset`` and zero the invalid ones.
+
+    The per-window dynamic slice runs on the VMEM tile (the HBM read
+    already happened at block granularity through the index_map), so the
+    data-dependent part of the cut never touches HBM.  Zeroing invalid
+    windows reproduces the masked-sum semantics of
+    ``ops.xcorr._masked_window_specs``: every downstream contribution of an
+    invalid window is exactly zero.
+    """
+    zero = jnp.int32(0)
+    segs = [lax.dynamic_slice(row, (zero, (rem + w * offset).astype(jnp.int32)),
+                              (1, wlen))[0]
+            for w in range(nwin)]
+    wins = jnp.stack(segs)                               # (nwin, wlen)
+    ok = (jnp.arange(nwin, dtype=jnp.int32) * offset + wlen) <= avail
+    return jnp.where(ok[:, None], wins, 0.0), ok
+
+
+def _rows(ch_lo, ch_hi, pv_lo, pv_hi):
+    """Concatenate each pair of adjacent grain blocks into a (1, 2G) row."""
+    row_ch = jnp.concatenate([ch_lo[0, 0, :], ch_hi[0, 0, :]])[None, :]
+    row_pv = jnp.concatenate([pv_lo[0, 0, :], pv_hi[0, 0, :]])[None, :]
+    return row_ch, row_pv
+
+
+def _pack_kernel(nwin: int, wlen: int, offset: int,
+                 sref, ch_lo, ch_hi, pv_lo, pv_hi, out_ch, out_pv):
+    """One grid step = one output channel: cut the channel's and the
+    pivot's ``nwin`` windows at this channel's start and emit them packed
+    (invalid windows zeroed).  Block shapes: inputs (1, 1, G) x4, outputs
+    (1, nwin, wlen_pad)."""
+    k = pl.program_id(0)
+    rem, avail = sref[0, k], sref[1, k]
+    row_ch, row_pv = _rows(ch_lo, ch_hi, pv_lo, pv_hi)
+    wins_ch, _ = _cut_windows(row_ch, rem, avail, nwin, wlen, offset)
+    wins_pv, _ = _cut_windows(row_pv, rem, avail, nwin, wlen, offset)
+    out_ch[:] = jnp.zeros(out_ch.shape, out_ch.dtype)
+    out_pv[:] = jnp.zeros(out_pv.shape, out_pv.dtype)
+    out_ch[0, :, 0:wlen] = wins_ch
+    out_pv[0, :, 0:wlen] = wins_pv
+
+
+def _dot_kernel(nwin: int, wlen: int, offset: int, swap: bool,
+                sref, ch_lo, ch_hi, pv_lo, pv_hi, out):
+    """Fully fused step: cut both traces' windows AND finish the circular
+    correlation in-kernel as an MXU dot against the doubled source-window
+    matrix.  ``c[w, k] = sum_n s2[w, n+k] * r[w, n]`` with ``s2 = [s, s]``
+    is exactly the reference's doubled-source "valid" correlate; the masked
+    window mean and the zero-lag centering roll happen here too, so the
+    output block is the final (1, wlen_pad) correlation row."""
+    k = pl.program_id(0)
+    rem, avail = sref[0, k], sref[1, k]
+    row_ch, row_pv = _rows(ch_lo, ch_hi, pv_lo, pv_hi)
+    wins_ch, ok = _cut_windows(row_ch, rem, avail, nwin, wlen, offset)
+    wins_pv, _ = _cut_windows(row_pv, rem, avail, nwin, wlen, offset)
+    src, rcv = (wins_pv, wins_ch) if swap else (wins_ch, wins_pv)
+    s2 = jnp.concatenate([src, src], axis=1)             # (nwin, 2*wlen)
+    # doubled-window matrix D[w, k, :] = s2[w, k:k+wlen]: wlen STATIC
+    # slices (bounded by DOT_MAX_WLEN), then one batched MXU contraction
+    dmat = jnp.stack([s2[:, j:j + wlen] for j in range(wlen)], axis=1)
+    c = lax.dot_general(dmat, rcv, (((2,), (1,)), ((0,), (0,))),
+                        precision=lax.Precision.HIGHEST,
+                        preferred_element_type=rcv.dtype)  # (nwin, wlen)
+    n_eff = jnp.sum(ok.astype(c.dtype))
+    row = jnp.sum(c, axis=0) / jnp.maximum(n_eff, 1)
+    row = jnp.roll(row, wlen // 2)                       # zero lag -> wlen//2
+    out[:] = jnp.zeros(out.shape, out.dtype)
+    out[0, 0:wlen] = row
+
+
+def _traj_scalars(dt_idx, ch_indices, pivot_idx, nt: int, nsamp: int,
+                  grain: int, backward: bool):
+    """Per-channel prefetch scalars: (5, nk) int32
+    [rem, avail, row, blk, pivot_row].
+
+    The truncation/empty-slice arithmetic is ``ops.xcorr``'s shared
+    :func:`~das_diff_veh_tpu.ops.xcorr.window_slice_avail` — one source of
+    truth for the numpy-parity semantics on both paths.  The pivot row
+    index rides the scalar operand too (broadcast), so a traced pivot is
+    as legal here as on the serialized path.
+    """
+    from das_diff_veh_tpu.ops.xcorr import window_slice_avail
+
+    start = dt_idx.astype(jnp.int32)
+    s0, avail = window_slice_avail(start, nt, nsamp, backward)
+    base = jnp.clip(s0, 0, nt)
+    blk = base // grain
+    rem = base - blk * grain
+    pv = jnp.full(ch_indices.shape, pivot_idx)
+    return jnp.stack([rem, avail.astype(jnp.int32),
+                      ch_indices.astype(jnp.int32), blk,
+                      pv.astype(jnp.int32)]).astype(jnp.int32)
+
+
+def _blocked_record(data: jnp.ndarray, grain: int):
+    """Zero-pad the (nch, nt) record and reshape to (nch, nblk, G) grain
+    blocks so any clipped start's two covering blocks are in range.  Valid
+    windows never reach the pad (their samples lie in ``[0, nt)`` by the
+    ``avail`` bounds); pad samples only feed windows that are zeroed."""
+    nt = data.shape[-1]
+    nblk = nt // grain + 2
+    dpad = jnp.pad(data, ((0, 0), (0, nblk * grain - nt)))
+    return dpad.reshape(data.shape[0], nblk, grain)
+
+
+def _gather_specs(grain: int):
+    """The four block index maps: channel row at the channel's block, the
+    pivot row at the SAME block (shared per-channel window), each with its
+    ``+1`` neighbor so the in-kernel element shift stays in range.  Every
+    index — channel row, pivot row, block — comes from the prefetched
+    scalar operand."""
+    return [
+        pl.BlockSpec((1, 1, grain), lambda k, s: (s[2, k], s[3, k], 0)),
+        pl.BlockSpec((1, 1, grain), lambda k, s: (s[2, k], s[3, k] + 1, 0)),
+        pl.BlockSpec((1, 1, grain), lambda k, s: (s[4, k], s[3, k], 0)),
+        pl.BlockSpec((1, 1, grain), lambda k, s: (s[4, k], s[3, k] + 1, 0)),
+    ]
+
+
+def _fused_call(data, pivot_idx, ch_indices, dt_idx, nsamp: int, wlen: int,
+                backward: bool, interpret: bool | None, kernel, out_specs,
+                out_shape_fn):
+    """Shared harness of both fused entry points: resolve interpret mode,
+    compute the grain, block the record, build the prefetch scalars, and
+    run ``kernel`` over the ``(nk,)`` grid with the four gather specs.
+    ``out_shape_fn(nk, wlen_pad, dtype)`` supplies the finish-specific
+    output aval(s); returns ``(outs, scal, wlen_pad)``."""
+    nt = data.shape[-1]
+    nk = ch_indices.shape[0]
+    interpret = _resolve_interpret(interpret)
+    grain = _round_up(nsamp, _LANE)     # nwin >= 1 guarantees nsamp >= wlen
+    wlen_pad = _round_up(wlen, _LANE)
+    data3 = _blocked_record(data, grain)
+    scal = _traj_scalars(dt_idx, ch_indices, pivot_idx, nt, nsamp, grain,
+                         backward)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nk,),
+        in_specs=_gather_specs(grain),
+        out_specs=out_specs,
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape_fn(nk, wlen_pad, data.dtype),
+        interpret=interpret,
+    )(scal, data3, data3, data3, data3)
+    return outs, scal, wlen_pad
+
+
+def traj_follow_windows(data: jnp.ndarray, pivot_idx,
+                        ch_indices: jnp.ndarray, dt_idx: jnp.ndarray,
+                        nsamp: int, wlen: int, offset: int,
+                        backward: bool = False,
+                        interpret: bool | None = None):
+    """Fused window cut: packed ``(nk, nwin, wlen)`` channel and pivot
+    window tensors, one kernel sweep over the ``nk`` output channels
+    (invalid windows zeroed, ``n_eff`` per channel returned).
+
+    This is the "(a)" finish: the caller runs the existing batched-rfft
+    circular correlate on the packed windows.  Valid windows are
+    bit-identical to the serialized cut's.
+    """
+    nwin = (nsamp - wlen) // offset + 1
+    _check_fused(nwin, wlen, None)
+    if ch_indices.shape[0] == 0:
+        z = jnp.zeros((0, nwin, wlen), data.dtype)
+        return z, z, jnp.zeros((0,), jnp.int32)
+    wp = _round_up(wlen, _LANE)
+    (wins_ch, wins_pv), scal, _ = _fused_call(
+        data, pivot_idx, ch_indices, dt_idx, nsamp, wlen, backward,
+        interpret,
+        kernel=partial(_pack_kernel, nwin, wlen, offset),
+        out_specs=[pl.BlockSpec((1, nwin, wp), lambda k, s: (k, 0, 0))] * 2,
+        out_shape_fn=lambda nk, wlen_pad, dt: [
+            jax.ShapeDtypeStruct((nk, nwin, wlen_pad), dt)] * 2)
+    n_eff = jnp.sum((jnp.arange(nwin, dtype=jnp.int32)[None, :] * offset
+                     + wlen) <= scal[1][:, None], axis=1)
+    return wins_ch[..., :wlen], wins_pv[..., :wlen], n_eff
+
+
+def traj_follow_correlate_dot(data: jnp.ndarray, pivot_idx,
+                              ch_indices: jnp.ndarray, dt_idx: jnp.ndarray,
+                              nsamp: int, wlen: int, offset: int,
+                              backward: bool = False, swap: bool = False,
+                              interpret: bool | None = None) -> jnp.ndarray:
+    """Fully fused gather+correlate ("(b)" finish): the kernel cuts both
+    traces' windows AND finishes the circular correlation as an in-kernel
+    MXU dot — returns the final rolled ``(nk, wlen)`` correlation rows.
+    ``swap=True`` correlates (src=pivot, rcv=channel), the reverse-side
+    operand order of ``xcorr_traj_follow``."""
+    nwin = (nsamp - wlen) // offset + 1
+    _check_fused(nwin, wlen, "dot")
+    if ch_indices.shape[0] == 0:
+        return jnp.zeros((0, wlen), data.dtype)
+    wp = _round_up(wlen, _LANE)
+    out, _, _ = _fused_call(
+        data, pivot_idx, ch_indices, dt_idx, nsamp, wlen, backward,
+        interpret,
+        kernel=partial(_dot_kernel, nwin, wlen, offset, swap),
+        out_specs=pl.BlockSpec((1, wp), lambda k, s: (k, 0)),
+        out_shape_fn=lambda nk, wlen_pad, dt: jax.ShapeDtypeStruct(
+            (nk, wlen_pad), dt))
+    return out[:, :wlen]
+
+
+def _check_fused(nwin: int, wlen: int, finish: str | None) -> None:
+    if nwin < 1:
+        raise ValueError(
+            f"fused gather needs at least one window (nwin={nwin}: "
+            f"nsamp < wlen?)")
+    if nwin > FUSED_MAX_NWIN:
+        raise ValueError(
+            f"fused gather unrolls nwin={nwin} window cuts per grid step; "
+            f"past FUSED_MAX_NWIN={FUSED_MAX_NWIN} use the serialized path "
+            f"(traj_gather='serialized')")
+    if finish == "dot" and (wlen > DOT_MAX_WLEN
+                            or nwin * wlen * wlen > DOT_MAX_MATRIX_ELEMS):
+        raise ValueError(
+            f"dot finish materializes a ({nwin}, {wlen}, {wlen}) doubled-"
+            f"window matrix in VMEM; past wlen > DOT_MAX_WLEN={DOT_MAX_WLEN} "
+            f"or nwin*wlen^2 > DOT_MAX_MATRIX_ELEMS={DOT_MAX_MATRIX_ELEMS} "
+            f"use the rfft finish (traj_gather_finish='rfft')")
+
+
+def fused_supported(nwin: int, wlen: int, finish: str) -> bool:
+    """Shape gate used by ``mode="auto"`` resolution in ``ops.xcorr``."""
+    if nwin < 1 or nwin > FUSED_MAX_NWIN:
+        return False
+    if finish == "dot" and (wlen > DOT_MAX_WLEN
+                            or nwin * wlen * wlen > DOT_MAX_MATRIX_ELEMS):
+        return False
+    return True

@@ -130,6 +130,28 @@ def xcorr_vshot_batch(data: jnp.ndarray, wlen: int, overlap_ratio: float = 0.5,
     return jnp.roll(out, wlen // 2, axis=-1)
 
 
+def window_slice_avail(start, nt: int, nsamp: int, backward: bool):
+    """Shared numpy-slice-parity arithmetic of the data-dependent window
+    cut: ``(s0, avail)`` where ``s0`` is the logical slice start and
+    ``avail`` how many of its ``nsamp`` samples actually exist.
+
+    ``backward=False``: the slice is ``[start, start+nsamp)``, truncated at
+    the record end like a numpy slice.  ``backward=True``: the slice is
+    ``[start-nsamp, start)``, *empty* whenever ``start < nsamp`` (numpy's
+    negative-start slice), truncated at the record end for ``start > nt``.
+    Both the serialized cut (:func:`_masked_window_specs`) and the fused
+    Pallas gather (``ops.pallas_gather._traj_scalars``) derive their
+    validity masks from this one function, so the two paths cannot
+    silently diverge on edge semantics."""
+    if backward:
+        s0 = start - nsamp
+        avail = jnp.where(s0 >= 0, jnp.clip(nt - s0, 0, nsamp), 0)
+    else:
+        s0 = start
+        avail = jnp.clip(nt - start, 0, nsamp)
+    return s0, avail
+
+
 def _masked_window_specs(data: jnp.ndarray, start, nsamp: int, wlen: int,
                          offset: int, backward: bool):
     """rfft of windows cut at *absolute* sample positions, with reference-parity
@@ -148,15 +170,7 @@ def _masked_window_specs(data: jnp.ndarray, start, nsamp: int, wlen: int,
     nt = data.shape[-1]
     nwin = (nsamp - wlen) // offset + 1
     w = jnp.arange(nwin)
-    if backward:
-        s0 = start - nsamp
-        # numpy's data[start-nsamp:start]: empty for s0 < 0, truncated at
-        # the record end for start > nt — either way window w fits iff it
-        # lies inside the real samples
-        avail = jnp.where(s0 >= 0, jnp.clip(nt - s0, 0, nsamp), 0)
-    else:
-        s0 = start
-        avail = jnp.clip(nt - start, 0, nsamp)
+    s0, avail = window_slice_avail(start, nt, nsamp, backward)
     valid = (w * offset + wlen) <= avail                # (nwin,)
     # the nwin overlapping windows tile ONE contiguous nsamp block: cut that
     # block with a single dynamic slice (the serialized-slice loop is the
@@ -218,10 +232,37 @@ def xcorr_vshot_at(data: jnp.ndarray, ivs, start, nsamp: int, wlen: int,
     return jnp.roll(out, wlen // 2, axis=-1)
 
 
+def _decide_traj_gather(mode: str | None, nwin: int, wlen: int,
+                        finish: str) -> bool:
+    """Resolve the gather-path knob to fused (True) / serialized (False).
+
+    ``"auto"`` (the :class:`~das_diff_veh_tpu.config.GatherConfig` default)
+    mirrors ``pallas_xcorr._decide_pallas``: the Pallas kernel runs on TPU
+    backends (where the serialized slice chain is the measured hot path);
+    CPU keeps the XLA formulation — fused is still fully exercised there by
+    forcing ``mode="fused"`` (interpret-mode kernel, tests do).
+    """
+    if finish not in ("rfft", "dot"):
+        raise ValueError(f"traj_gather_finish must be 'rfft' or 'dot', "
+                         f"got {finish!r}")
+    if mode in (None, "auto"):
+        from das_diff_veh_tpu.ops.pallas_gather import fused_supported
+        return (jax.default_backend() in ("tpu", "axon")
+                and fused_supported(nwin, wlen, finish))
+    if mode == "serialized":
+        return False
+    if mode == "fused":
+        return True
+    raise ValueError(f"traj_gather must be 'auto', 'fused' or 'serialized', "
+                     f"got {mode!r}")
+
+
 def xcorr_traj_follow(data: jnp.ndarray, t_axis: jnp.ndarray, pivot_idx: int,
                       ch_indices: jnp.ndarray, t_at_ch: jnp.ndarray,
                       nsamp: int, wlen: int, overlap_ratio: float = 0.5,
-                      reverse: bool = False) -> jnp.ndarray:
+                      reverse: bool = False, *, mode: str | None = "auto",
+                      finish: str = "rfft",
+                      interpret: bool | None = None) -> jnp.ndarray:
     """Trajectory-following pair correlations (reference
     apis/virtual_shot_gather.py:14-43 xcorr_two_traces_based_on_traj).
 
@@ -231,8 +272,25 @@ def xcorr_traj_follow(data: jnp.ndarray, t_axis: jnp.ndarray, pivot_idx: int,
     per-channel window, then the pair runs through the masked windowed
     circular xcorr (numpy truncation/empty-slice parity, see
     :func:`xcorr_pair_at`).  Returns (len(ch_indices), wlen).
+
+    ``mode`` selects the window-cut engine: ``"serialized"`` is the legacy
+    vmapped ``dynamic_slice`` (an O(nch) serialized slice chain on TPU —
+    the pipeline's measured hottest op), ``"fused"`` the Pallas
+    scalar-prefetch gather kernel (``ops.pallas_gather``) that cuts every
+    channel's window in one grid sweep, ``"auto"`` picks fused on TPU
+    backends.  ``finish``: ``"rfft"`` runs the packed kernel windows
+    through this module's batched circular correlate (bit-parity with the
+    serialized path); ``"dot"`` finishes the correlation in-kernel as an
+    MXU dot (small ``wlen`` only).  ``interpret`` follows
+    ``ops.pallas_xcorr`` convention (None = interpret off-TPU).
     """
     dt_idx = jnp.argmax(t_axis[None, :] >= t_at_ch[:, None], axis=-1)
+    offset = int(wlen * (1.0 - overlap_ratio))
+    nwin = (nsamp - wlen) // offset + 1
+    if _decide_traj_gather(mode, nwin, wlen, finish):
+        return _traj_follow_fused(data, pivot_idx, ch_indices, dt_idx,
+                                  nsamp, wlen, offset, reverse, finish,
+                                  interpret)
 
     def one(ch, ti):
         tr_ch = data[ch]
@@ -246,3 +304,31 @@ def xcorr_traj_follow(data: jnp.ndarray, t_axis: jnp.ndarray, pivot_idx: int,
                              backward=False)
 
     return jax.vmap(one)(ch_indices, dt_idx)
+
+
+def _traj_follow_fused(data, pivot_idx, ch_indices, dt_idx, nsamp: int,
+                       wlen: int, offset: int, reverse: bool, finish: str,
+                       interpret: bool | None) -> jnp.ndarray:
+    """Fused gather path: one Pallas scalar-prefetch sweep cuts every
+    channel's (and the pivot's) windows at that channel's data-dependent
+    start; the circular correlate runs on the packed windows (``"rfft"``)
+    or inside the kernel (``"dot"``).  Operand order and backward-window
+    semantics match the serialized path exactly."""
+    from das_diff_veh_tpu.ops import pallas_gather as pg
+
+    if finish == "dot":
+        return pg.traj_follow_correlate_dot(
+            data, pivot_idx, ch_indices, dt_idx, nsamp, wlen, offset,
+            backward=reverse, swap=reverse, interpret=interpret)
+    wins_ch, wins_pv, n_eff = pg.traj_follow_windows(
+        data, pivot_idx, ch_indices, dt_idx, nsamp, wlen, offset,
+        backward=reverse, interpret=interpret)
+    cf = jnp.fft.rfft(wins_ch, axis=-1)                 # (nk, nwin, nf)
+    pf = jnp.fft.rfft(wins_pv, axis=-1)
+    src_f, rcv_f = (pf, cf) if reverse else (cf, pf)
+    c = _circ_corr_freq(src_f, rcv_f, wlen)             # (nk, nwin, wlen)
+    # invalid windows are zeroed in BOTH operands by the kernel, so their
+    # cross-spectra are exactly zero: the plain window sum equals the
+    # serialized path's masked sum bit-for-bit
+    out = jnp.sum(c, axis=1) / jnp.maximum(n_eff, 1)[:, None]
+    return jnp.roll(out, wlen // 2, axis=-1)

@@ -9,17 +9,54 @@ fails in tier-1 on CPU, not only as a memory blow-up on the chip.
 import jax
 
 
-def iter_eqns(jaxpr):
+def iter_eqns(jaxpr, skip_primitives=()):
     """Yield every equation of ``jaxpr``, recursing through the sub-jaxprs
-    carried in equation params (scan/pjit/cond/shard_map/...)."""
+    carried in equation params (scan/pjit/cond/shard_map/...).  Equations
+    whose primitive is in ``skip_primitives`` are skipped entirely
+    (neither yielded nor recursed into)."""
     for eqn in jaxpr.eqns:
+        if eqn.primitive.name in skip_primitives:
+            continue
         yield eqn
         for p in eqn.params.values():
             for j in (p if isinstance(p, (list, tuple)) else [p]):
                 if isinstance(j, jax.core.ClosedJaxpr):
-                    yield from iter_eqns(j.jaxpr)
+                    yield from iter_eqns(j.jaxpr, skip_primitives)
                 elif isinstance(j, jax.core.Jaxpr):
-                    yield from iter_eqns(j)
+                    yield from iter_eqns(j, skip_primitives)
+
+
+def iter_eqns_outside_kernels(jaxpr):
+    """:func:`iter_eqns` minus ``pallas_call`` bodies: slicing *inside* a
+    kernel runs once per grid step on a VMEM-resident tile (the fused
+    gather's in-kernel shift), which is exactly what replaces an XLA-level
+    serialized slice chain — only equations in the surrounding program
+    count against the no-chain claims."""
+    return iter_eqns(jaxpr, skip_primitives=("pallas_call",))
+
+
+def record_cut_slices(closed_jaxpr, record_len):
+    """Equations *outside any Pallas kernel* that cut the time axis of a
+    record-shaped operand: ``gather``/``dynamic_slice`` whose operand's
+    last dim is at least ``record_len`` and whose output's last dim is
+    smaller.  A vmapped traced-start ``dynamic_slice`` over channels — the
+    serialized O(nch) slice chain the fused gather kernel exists to
+    replace — appears here as exactly such a gather; the fused path must
+    produce NONE (its data-dependent cut lives inside ``pallas_call``)."""
+    found = []
+    for eqn in iter_eqns_outside_kernels(closed_jaxpr.jaxpr):
+        if eqn.primitive.name not in ("gather", "dynamic_slice"):
+            continue
+        src = getattr(eqn.invars[0].aval, "shape", ())
+        dst = getattr(eqn.outvars[0].aval, "shape", ())
+        if (src and dst and src[-1] >= record_len and dst[-1] < src[-1]):
+            found.append(eqn)
+    return found
+
+
+def has_primitive(closed_jaxpr, name):
+    """True iff an equation with the named primitive appears anywhere."""
+    return any(e.primitive.name == name for e in iter_eqns(closed_jaxpr.jaxpr))
 
 
 def window_axis_pads(closed_jaxpr, nwin):

@@ -1,0 +1,236 @@
+"""Fused trajectory-following gather kernel (ops/pallas_gather.py): parity
+against the serialized path on forward/backward/reverse/edge-truncated
+cases, the structural no-serialized-slice-chain jaxpr pin, and the
+GatherConfig knob plumbing.  The kernel runs in interpret mode here (CPU
+CI, ``mode="fused"`` forces it past the auto backend gate); the real-TPU
+lowering is exercised by bench.py's ``stage_gather_traj_*`` entries.
+
+Budget note: every case below is a small direct ``xcorr_traj_follow`` /
+``build_gather`` call — no ``process_chunk`` compiles (those cost ~40 s
+each on this host; the session-scoped conftest fixtures own them).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from das_diff_veh_tpu.config import GatherConfig
+from das_diff_veh_tpu.ops import xcorr as jx
+from das_diff_veh_tpu.ops.pallas_gather import DOT_MAX_WLEN, FUSED_MAX_NWIN
+
+RNG = np.random.default_rng(31)
+
+NCH, NT, WLEN, NSAMP = 10, 2000, 250, 800
+PIVOT = 6
+
+
+def _scene():
+    data = jnp.asarray(RNG.standard_normal((NCH, NT)))
+    t_axis = jnp.arange(NT) * 0.004                     # 8 s record
+    ch = jnp.asarray([2, 3, 5, 7])
+    return data, t_axis, ch
+
+
+def _both(data, t_axis, ch, t_at_ch, reverse, finish="rfft", **kw):
+    ser = np.asarray(jx.xcorr_traj_follow(data, t_axis, PIVOT, ch, t_at_ch,
+                                          NSAMP, WLEN, reverse=reverse,
+                                          mode="serialized", **kw))
+    fus = np.asarray(jx.xcorr_traj_follow(data, t_axis, PIVOT, ch, t_at_ch,
+                                          NSAMP, WLEN, reverse=reverse,
+                                          mode="fused", finish=finish, **kw))
+    return ser, fus
+
+
+@pytest.mark.parametrize("reverse", [False, True])
+def test_fused_parity_in_range(reverse):
+    """Acceptance bar: fused vs serialized <= 1e-7 (measured bitwise on the
+    rfft finish — the windows are identical copies and the correlate is the
+    same batched-rfft program)."""
+    data, t_axis, ch = _scene()
+    t_at_ch = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    ser, fus = _both(data, t_axis, ch, t_at_ch, reverse)
+    np.testing.assert_allclose(fus, ser, rtol=0, atol=1e-7)
+    np.testing.assert_array_equal(fus, ser)             # and in fact exact
+
+
+@pytest.mark.parametrize("reverse", [False, True])
+def test_fused_parity_record_edge_truncated(reverse):
+    """Starts at/past the record end: forward windows truncate like a numpy
+    slice, backward starts past nt truncate from the far side — the
+    kernel's avail masks must reproduce the serialized path exactly."""
+    data, t_axis, ch = _scene()
+    # dt_idx lands near nt, at nt-1, and past every sample (argmax -> 0 for
+    # the never-true comparison is exercised by t > t_axis.max())
+    t_at_ch = jnp.asarray([6.9, 7.5, 7.996, 4.0])
+    ser, fus = _both(data, t_axis, ch, t_at_ch, reverse)
+    np.testing.assert_array_equal(fus, ser)
+
+
+def test_fused_parity_backward_empty_slice():
+    """Backward windows with start < nsamp are numpy's empty slice: every
+    window invalid, output rows exactly zero on both paths."""
+    data, t_axis, ch = _scene()
+    t_at_ch = jnp.asarray([0.1, 0.5, 3.5, 5.0])        # first two < nsamp*dt
+    ser, fus = _both(data, t_axis, ch, t_at_ch, reverse=True)
+    np.testing.assert_array_equal(fus, ser)
+    assert np.abs(ser[:2]).max() == 0.0                 # the empty-slice rows
+    assert np.abs(ser[2:]).max() > 0.0                  # the live rows
+
+
+def test_fused_parity_float32():
+    """The pipeline feeds float32 records; parity must not depend on the
+    x64 default the test session enables."""
+    data, t_axis, ch = _scene()
+    t_at_ch = jnp.asarray([1.0, 2.5, 3.0, 6.5])
+    ser, fus = _both(data.astype(jnp.float32), t_axis, ch, t_at_ch, False)
+    assert fus.dtype == np.float32
+    np.testing.assert_array_equal(fus, ser)
+
+
+@pytest.mark.parametrize("reverse", [False, True])
+def test_dot_finish_matches_rfft(reverse):
+    """The in-kernel MXU dot finish is the same circular correlation
+    evaluated in the time domain: equal to the rfft finish to float
+    rounding (x64 session: ~1e-13; far inside the 1e-7 oracle bar)."""
+    data, t_axis, ch = _scene()
+    t_at_ch = jnp.asarray([1.0, 2.0, 3.0, 7.9])        # incl. a truncated row
+    ser, dot = _both(data, t_axis, ch, t_at_ch, reverse, finish="dot")
+    np.testing.assert_allclose(dot, ser, rtol=0, atol=1e-7)
+
+
+def test_fused_under_jit_vmap():
+    """The vsg pipeline calls the gather inside jit(vmap(...)): the
+    scalar-prefetch pallas_call must batch (window-batch axis) and match
+    the per-window results."""
+    data, t_axis, ch = _scene()
+    t_at_ch = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    f = jax.jit(lambda d, t: jx.xcorr_traj_follow(
+        d, t_axis, PIVOT, ch, t, NSAMP, WLEN, mode="fused"))
+    db = jnp.stack([data, data * 0.5 + 1.0])
+    tb = jnp.stack([t_at_ch, t_at_ch + 0.5])
+    got = np.asarray(jax.vmap(f)(db, tb))
+    for i in range(2):
+        np.testing.assert_array_equal(got[i], np.asarray(f(db[i], tb[i])))
+
+
+def test_fused_traced_pivot():
+    """The pivot row index rides the prefetched scalar operand, so a
+    *traced* pivot (legal on the serialized path — cf. xcorr_vshot's
+    traced ``ivs``) is equally legal on the fused path."""
+    data, t_axis, ch = _scene()
+    t_at_ch = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    f = jax.jit(lambda d, pv: jx.xcorr_traj_follow(
+        d, t_axis, pv, ch, t_at_ch, NSAMP, WLEN, mode="fused"))
+    got = np.asarray(f(data, jnp.int32(PIVOT)))
+    want = np.asarray(jx.xcorr_traj_follow(data, t_axis, PIVOT, ch, t_at_ch,
+                                           NSAMP, WLEN, mode="serialized"))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_no_serialized_slice_chain_jaxpr():
+    """Structural acceptance pin: the fused program contains NO record-
+    cutting gather/dynamic-slice outside the kernel (the serialized chain
+    XLA would sequence on TPU) and DOES contain the pallas_call; the
+    serialized program trips the same detector — which validates it."""
+    from jaxpr_checks import has_primitive, record_cut_slices
+
+    data, t_axis, ch = _scene()
+    t_at_ch = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+
+    def traced(mode):
+        return jax.make_jaxpr(
+            lambda d, t: jx.xcorr_traj_follow(d, t_axis, PIVOT, ch, t,
+                                              NSAMP, WLEN, mode=mode))(
+            data, t_at_ch)
+
+    fused = traced("fused")
+    assert not record_cut_slices(fused, NT), \
+        f"record cut outside the kernel: {record_cut_slices(fused, NT)}"
+    assert has_primitive(fused, "pallas_call")
+    serialized = traced("serialized")
+    assert record_cut_slices(serialized, NT), \
+        "detector failed to flag the legacy serialized slice chain"
+    assert not has_primitive(serialized, "pallas_call")
+
+
+def test_vsg_gather_config_knob():
+    """build_gather honors GatherConfig.traj_gather: fused and serialized
+    configurations agree at the oracle bar through the full gather (both
+    xcorr_traj_follow sides AND the xcorr_vshot_at near/right slabs, i.e.
+    parity of the composed program against the existing engine)."""
+    from test_vsg import _window_scene
+
+    from das_diff_veh_tpu.models import vsg as V
+
+    data, x, t, traj_x, traj_t, x0 = _window_scene()
+    args = (jnp.asarray(data), jnp.asarray(t), jnp.asarray(x),
+            jnp.asarray(traj_x), jnp.asarray(traj_t),
+            jnp.ones(traj_t.size, bool))
+    outs = {}
+    for mode in ("serialized", "fused"):
+        cfg = GatherConfig(traj_gather=mode)
+        g = V.VsgGeometry.build(x, t[1] - t[0], x0, x0 - 150.0, x0 + 75.0,
+                                cfg)
+        outs[mode] = np.asarray(V.build_gather(*args, g, cfg))
+    np.testing.assert_allclose(outs["fused"], outs["serialized"],
+                               rtol=0, atol=1e-7)
+
+
+def test_auto_mode_serialized_on_cpu():
+    """``"auto"`` (the config default) resolves to the serialized path off
+    TPU — same backend gate as pallas_xcorr._decide_pallas — so the CPU
+    pipeline programs (and their tier-1 compile times) are unchanged."""
+    from das_diff_veh_tpu.ops.xcorr import _decide_traj_gather
+
+    assert jax.default_backend() == "cpu"
+    assert _decide_traj_gather("auto", 5, WLEN, "rfft") is False
+    assert _decide_traj_gather(None, 5, WLEN, "rfft") is False
+    assert _decide_traj_gather("fused", 5, WLEN, "rfft") is True
+    assert _decide_traj_gather("serialized", 5, WLEN, "rfft") is False
+
+
+def test_invalid_knobs_rejected():
+    data, t_axis, ch = _scene()
+    t_at_ch = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    with pytest.raises(ValueError, match="traj_gather"):
+        jx.xcorr_traj_follow(data, t_axis, PIVOT, ch, t_at_ch, NSAMP, WLEN,
+                             mode="warp")
+    with pytest.raises(ValueError, match="traj_gather_finish"):
+        jx.xcorr_traj_follow(data, t_axis, PIVOT, ch, t_at_ch, NSAMP, WLEN,
+                             mode="fused", finish="fft2")
+    # dot finish past the VMEM cap: explicit request raises with guidance
+    big_wlen = DOT_MAX_WLEN + 2
+    with pytest.raises(ValueError, match="DOT_MAX_WLEN"):
+        jx.xcorr_traj_follow(data, t_axis, PIVOT, ch, t_at_ch,
+                             4 * big_wlen, big_wlen, mode="fused",
+                             finish="dot")
+    # ... and the bound is JOINT in (nwin, wlen): an in-cap wlen with a
+    # window count that blows the (nwin, wlen, wlen) VMEM matrix also
+    # raises (and auto falls back rather than lowering it)
+    from das_diff_veh_tpu.ops.pallas_gather import fused_supported
+    nwin_many = 17                                      # 17*256^2 > 2^20
+    nsamp_many = (nwin_many - 1) * (DOT_MAX_WLEN // 2) + DOT_MAX_WLEN
+    assert not fused_supported(nwin_many, DOT_MAX_WLEN, "dot")
+    with pytest.raises(ValueError, match="DOT_MAX_MATRIX_ELEMS"):
+        jx.xcorr_traj_follow(data, t_axis, PIVOT, ch, t_at_ch,
+                             nsamp_many, DOT_MAX_WLEN, mode="fused",
+                             finish="dot")
+    # past the per-step unroll bound the fused path refuses (auto falls
+    # back to serialized instead — fused_supported gates it)
+    small_wlen = 16
+    nsamp_big = (FUSED_MAX_NWIN + 2) * (small_wlen // 2) + small_wlen
+    assert not fused_supported(FUSED_MAX_NWIN + 2, small_wlen, "rfft")
+    with pytest.raises(ValueError, match="FUSED_MAX_NWIN"):
+        jx.xcorr_traj_follow(data, t_axis, PIVOT, ch, t_at_ch,
+                             nsamp_big, small_wlen, mode="fused")
+
+
+def test_empty_channel_set():
+    """nk = 0 (pivot adjacent to the gather end) short-circuits to an
+    empty result on the fused path like the vmapped legacy path."""
+    data, t_axis, _ = _scene()
+    empty = jnp.asarray([], dtype=jnp.int32)
+    tt = jnp.asarray([])
+    ser, fus = _both(data, t_axis, empty, tt, reverse=False)
+    assert ser.shape == fus.shape == (0, WLEN)
