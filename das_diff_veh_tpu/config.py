@@ -264,6 +264,75 @@ class RingConfig:
 
 
 @dataclass(frozen=True)
+class ObsConfig:
+    """Observability knobs (``das_diff_veh_tpu.obs``), shared by the batch
+    runtime (``RuntimeConfig.obs``) and the serving engine
+    (``ServeConfig.obs``).  Pure execution knobs: none of them changes an
+    output bit, and the batch resume manifest's config hash excludes them.
+    The full model (registry, Prometheus scrape, flight-recorder workflow,
+    profiler window) is documented in docs/OBSERVABILITY.md.
+    """
+
+    enabled: bool = True
+    """Master switch for the batch runtime's observability instrumentation
+    (registry families, flight ring, sink/profiler/monitoring hooks).
+    False turns ALL of it off — the bench ``obs_overhead`` A/B's bare
+    side, so the committed <2% number measures the whole stack, not just
+    the optional artifact writers.  The serve engine's metrics are its
+    product surface (``/v1/metrics`` is built from them) and ignore this
+    switch."""
+
+    metrics_jsonl: Optional[str] = None
+    """Append periodic registry snapshots (one JSON line each) here during
+    batch runs — the scrapeless counterpart of the serve front's
+    ``GET /metrics``.  None disables the sink."""
+
+    metrics_interval_s: float = 10.0
+    """Seconds between JSONL sink snapshots (a final line is always written
+    when the run ends)."""
+
+    flight_dir: Optional[str] = None
+    """Directory for crash-flight-recorder dumps.  When set, the last
+    ``flight_capacity`` per-chunk / per-request records are written as a
+    JSON artifact on quarantine, shed, unhandled error, and SIGTERM
+    (``scripts/obs_report.py`` renders them).  None keeps the in-memory
+    ring but never writes."""
+
+    flight_capacity: int = 256
+    """Records retained in the flight-recorder ring."""
+
+    profile_dir: Optional[str] = None
+    """Write a programmatic ``jax.profiler`` capture of
+    ``profile_n_chunks`` steady-state chunks here (batch runs; the window
+    opens after ``profile_start_chunk`` chunks so compile/warmup noise
+    stays out).  This is the device-truth view docs/PERF.md's "stage_* is
+    a budget statement" caveat points at.  None disables."""
+
+    profile_start_chunk: int = 3
+    """Chunks to skip before the profiler window opens (warmup exclusion)."""
+
+    profile_n_chunks: int = 2
+    """Chunks captured inside the profiler window."""
+
+    hbm_sample_interval_s: float = 0.0
+    """Background per-device ``memory_stats()`` sampling period [s] (the
+    bench.py peak-bytes pattern made continuous).  0 registers the lazy
+    scrape-time gauges only — no thread."""
+
+    trace_flush_interval_s: float = 0.0
+    """Chrome-trace writer flush cadence.  0 (default) flushes every event
+    line — crash-durable, one syscall per span.  > 0 batches writes and
+    flushes at most every this many seconds (tight per-chunk loops stop
+    paying a syscall per span; an unclean kill can lose up to one
+    interval's events)."""
+
+    xla_events: bool = True
+    """Subscribe the run's registry to ``jax.monitoring`` compile/trace
+    events (``das_jax_traces_total`` etc. — the device-truth counters the
+    zero-steady-state-compiles gauge is built on)."""
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Online serving engine knobs (``das_diff_veh_tpu.serve``).
 
@@ -312,6 +381,11 @@ class ServeConfig:
     (``jax_compilation_cache_dir``) applied at engine start, so warmups are
     near-free across process restarts.  None leaves the process setting
     untouched."""
+
+    obs: ObsConfig = field(default_factory=ObsConfig)
+    """Observability knobs: flight-recorder dumps on shed/error paths and
+    the ``jax.monitoring`` compile counters behind the
+    ``das_serve_steady_state_compiles`` gauge (see :class:`ObsConfig`)."""
 
 
 @dataclass(frozen=True)

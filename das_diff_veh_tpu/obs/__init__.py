@@ -1,0 +1,40 @@
+"""Unified observability layer: one registry, device truth, a flight recorder.
+
+Five concerns, one module each:
+
+- :mod:`registry` — thread-safe counters/gauges/bounded-ring histograms
+  with labeled families; renders as Prometheus text exposition (the serve
+  HTTP front's ``GET /metrics``) and as a JSON snapshot.  Every subsystem
+  (``serve``, ``runtime``, ``parallel``) registers into the same registry,
+  so one scrape / one sink line carries the whole process;
+- :mod:`sink` — periodic JSONL snapshots for batch runs (no scraper);
+- :mod:`xla_events` — ``jax.monitoring`` listener counting jaxpr traces and
+  backend compiles: the "zero steady-state compiles" SLO measured at the
+  JAX layer, not inferred from the compiled-function cache's own counters;
+- :mod:`profiling` — knob-gated programmatic ``jax.profiler`` window around
+  N steady-state chunks, plus per-device ``memory_stats()`` gauges/sampler
+  (device-side truth where host ``stage_*`` spans mislead — docs/PERF.md);
+- :mod:`flight` — bounded ring of recent per-chunk/per-request records
+  dumped to a JSON artifact on quarantine, shed, unhandled error, or
+  SIGTERM; rendered by ``scripts/obs_report.py``.
+
+Knobs live in ``config.ObsConfig`` (referenced by both ``RuntimeConfig``
+and ``ServeConfig``); the full model is documented in
+docs/OBSERVABILITY.md.
+"""
+
+from das_diff_veh_tpu.obs.flight import FlightRecorder, load_flight_dump
+from das_diff_veh_tpu.obs.profiling import (HBMSampler, ProfilerWindow,
+                                            register_memory_gauges)
+from das_diff_veh_tpu.obs.registry import (MetricsRegistry, default_registry,
+                                           percentile)
+from das_diff_veh_tpu.obs.sink import MetricsSink, load_metrics_jsonl
+from das_diff_veh_tpu.obs.xla_events import CompileWatch, install, uninstall
+
+__all__ = [
+    "MetricsRegistry", "default_registry", "percentile",
+    "MetricsSink", "load_metrics_jsonl",
+    "CompileWatch", "install", "uninstall",
+    "ProfilerWindow", "HBMSampler", "register_memory_gauges",
+    "FlightRecorder", "load_flight_dump",
+]

@@ -1,0 +1,175 @@
+"""Device-truth profiling hooks: programmatic profiler windows + HBM gauges.
+
+docs/PERF.md concedes that standalone ``stage_*`` host timings "add up to
+more than the combined pipeline" — XLA fuses across stage boundaries, so
+wall-clock spans cannot attribute device time inside a fused program.  The
+two tools here produce device-side truth instead:
+
+- :class:`ProfilerWindow` — a knob-gated programmatic ``jax.profiler``
+  capture around N *steady-state* chunks of a batch run (skip the first
+  ``start_after`` chunks so compile/warmup noise stays out of the window).
+  Call :meth:`step` once per chunk; the window opens and closes itself and
+  the capture lands in ``profile_dir`` for TensorBoard/XProf.  This is the
+  measurement the ROADMAP's fused-``process_chunk`` item needs — per-op
+  device time inside the one dispatch, not host spans around it.
+
+- :class:`HBMSampler` / :func:`register_memory_gauges` — per-device memory
+  truth from ``device.memory_stats()`` (the bench.py peak-bytes pattern,
+  now continuous): ``das_device_bytes_in_use`` / ``das_device_peak_bytes``
+  labeled gauges per device.  The gauge form evaluates lazily at scrape
+  time (zero cost between scrapes); the sampler form adds a background
+  thread for platforms where ``bytes_in_use`` must be polled to catch
+  transients.  Platforms without allocator stats (CPU returns None) simply
+  leave the gauges at their last value.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional, Sequence
+
+from das_diff_veh_tpu.obs.registry import MetricsRegistry
+
+log = logging.getLogger("das_diff_veh_tpu.obs")
+
+
+class ProfilerWindow:
+    """Programmatic ``jax.profiler`` capture around N steady-state steps."""
+
+    def __init__(self, profile_dir: str, start_after: int = 3,
+                 n_steps: int = 2, registry: Optional[MetricsRegistry] = None):
+        self.profile_dir = profile_dir
+        self.start_after = int(start_after)
+        self.n_steps = max(int(n_steps), 1)
+        self._seen = 0
+        self._active = False
+        self._done = False
+        self._lock = threading.Lock()
+        self._gauge = None
+        if registry is not None:
+            self._gauge = registry.gauge(
+                "das_obs_profiled_steps",
+                "steps captured by the active profiler window")
+
+    def step(self) -> None:
+        """Advance one step (one chunk); opens/closes the capture window."""
+        with self._lock:
+            self._seen += 1
+            if self._done:
+                return
+            if not self._active and self._seen > self.start_after:
+                try:
+                    import jax
+                    jax.profiler.start_trace(self.profile_dir)
+                    self._active = True
+                    self._window_start = self._seen
+                except Exception as e:      # profiling must never kill a run
+                    log.warning("profiler window failed to start: %s", e)
+                    self._done = True
+                    return
+            if self._active:
+                captured = self._seen - self._window_start + 1
+                if self._gauge is not None:
+                    self._gauge.set(captured)
+                if captured >= self.n_steps:
+                    self._stop()
+
+    def _stop(self) -> None:
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception as e:
+            log.warning("profiler window failed to stop: %s", e)
+        self._active = False
+        self._done = True
+
+    def close(self) -> None:
+        """Stop a still-open window (run ended inside it)."""
+        with self._lock:
+            if self._active:
+                self._stop()
+
+    @property
+    def captured(self) -> bool:
+        with self._lock:
+            return self._done and not self._active
+
+
+def _device_label(dev) -> str:
+    return f"{getattr(dev, 'platform', 'dev')}:{getattr(dev, 'id', 0)}"
+
+
+def register_memory_gauges(registry: MetricsRegistry,
+                           devices: Optional[Sequence] = None) -> int:
+    """Lazy per-device memory gauges: ``das_device_bytes_in_use`` and
+    ``das_device_peak_bytes`` labeled by device, each reading
+    ``device.memory_stats()`` at scrape time.  Returns the number of
+    devices wired (0 when the platform has no allocator stats — the gauges
+    are still registered so the scrape shape is stable)."""
+    if devices is None:
+        import jax
+        devices = jax.devices()
+    in_use = registry.gauge("das_device_bytes_in_use",
+                            "allocator bytes in use", labels=("device",))
+    peak = registry.gauge("das_device_peak_bytes",
+                          "allocator peak bytes in use", labels=("device",))
+    wired = 0
+    for dev in devices:
+        lbl = _device_label(dev)
+        in_use.labels(device=lbl).set_fn(lambda d=dev: _stat(d, "bytes_in_use"))
+        peak.labels(device=lbl).set_fn(
+            lambda d=dev: _stat(d, "peak_bytes_in_use"))
+        try:
+            if dev.memory_stats() is not None:
+                wired += 1
+        except Exception:
+            pass
+    return wired
+
+
+def _stat(dev, key: str):
+    stats = dev.memory_stats()
+    return None if stats is None else stats.get(key)
+
+
+class HBMSampler:
+    """Background thread refreshing the per-device memory gauges every
+    ``interval_s`` — for catching transient peaks between scrapes (the
+    ring-pipeline working set lives and dies inside one dispatch)."""
+
+    def __init__(self, registry: MetricsRegistry, interval_s: float = 1.0,
+                 devices: Optional[Sequence] = None):
+        if devices is None:
+            import jax
+            devices = jax.devices()
+        self._devices = list(devices)
+        register_memory_gauges(registry, self._devices)
+        self._in_use = registry.gauge("das_device_bytes_in_use",
+                                      labels=("device",))
+        self._peak = registry.gauge("das_device_peak_bytes",
+                                    labels=("device",))
+        self._interval = max(float(interval_s), 0.01)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, name="obs-hbm",
+                                        daemon=True)
+        self._thread.start()
+
+    def _sample(self) -> None:
+        for dev in self._devices:
+            # reading .value evaluates the set_fn and caches the result, so
+            # the sampler and the scraper share one code path
+            lbl = _device_label(dev)
+            self._in_use.labels(device=lbl).value
+            self._peak.labels(device=lbl).value
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self._sample()
+            except Exception:       # a dead device must not kill the thread
+                pass
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)

@@ -1,0 +1,141 @@
+"""Device-truth compile/transfer accounting via ``jax.monitoring``.
+
+The serve layer's "zero steady-state compiles" SLO was previously asserted
+only through the compiled-function cache's OWN counters — which can't see a
+compile that happens outside the cache (a stray un-warmed jit in a compute
+function, a shape leaking through padding).  ``jax.monitoring`` is the
+ground truth: JAX emits a duration event for every jaxpr trace
+(``/jax/core/compile/jaxpr_trace_duration``) and every backend compile
+(``/jax/core/compile/backend_compile_duration``) regardless of who
+triggered it, so counting those events turns the SLO into a registry gauge
+assertable in tests and scrapable in production.
+
+``jax.monitoring`` has no public per-listener unregister, so this module
+registers ONE module-level forwarding listener (lazily, on first
+:func:`install`) and fans events out to the currently-subscribed
+registries; :func:`uninstall` drops a registry from the fan-out without
+touching JAX state.  Counted into each subscribed registry:
+
+- ``das_jax_traces_total`` — jaxpr traces (fires on every fresh jit
+  lowering, persistent compilation cache hit or not — the steady-state
+  gauge keys off this one);
+- ``das_jax_compiles_total`` / ``das_jax_compile_seconds_total`` — actual
+  backend compiles and their summed duration (a persistent-cache hit skips
+  these);
+- ``das_jax_events_total{event=...}`` — every other monitoring event by
+  name (compilation-cache hits/misses, and on real TPU platforms the
+  transfer/fusion events the backend emits), so device-side activity this
+  module doesn't special-case still lands in the scrape.
+
+Wired in by ``serve.engine.ServingEngine`` (plus a
+``das_serve_steady_state_compiles`` gauge anchored at warmup end) and by
+``pipeline.workflow.run_directory``; knob-gated by ``ObsConfig.xla_events``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from das_diff_veh_tpu.obs.registry import MetricsRegistry
+
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+# registry -> subscription count.  Ref-counted because independent
+# components legitimately share one registry (the serve CLI's engine and
+# an in-process batch run both install the process default): the first
+# uninstall must not silently freeze the other component's counters.
+_subscribers: Dict[MetricsRegistry, int] = {}
+_installed = False
+
+
+def _fanout_event(event: str, **kw) -> None:
+    with _lock:
+        regs = list(_subscribers)
+    for reg in regs:
+        reg.counter("das_jax_events_total",
+                    "jax.monitoring events by name",
+                    labels=("event",)).labels(event=event).inc()
+
+
+def _fanout_duration(event: str, duration_secs: float, **kw) -> None:
+    with _lock:
+        regs = list(_subscribers)
+    for reg in regs:
+        if event == _TRACE_EVENT:
+            reg.counter("das_jax_traces_total",
+                        "jaxpr traces (fresh jit lowerings)").inc()
+        elif event == _COMPILE_EVENT:
+            reg.counter("das_jax_compiles_total",
+                        "XLA backend compiles").inc()
+            reg.counter("das_jax_compile_seconds_total",
+                        "summed backend compile time").inc(duration_secs)
+        else:
+            reg.counter("das_jax_events_total",
+                        "jax.monitoring events by name",
+                        labels=("event",)).labels(event=event).inc()
+
+
+def _ensure_listener() -> None:
+    global _installed
+    if _installed:
+        return
+    from jax import monitoring
+    monitoring.register_event_listener(_fanout_event)
+    monitoring.register_event_duration_secs_listener(_fanout_duration)
+    _installed = True
+
+
+class CompileWatch:
+    """Read-side view of one registry's compile counters."""
+
+    def __init__(self, registry: MetricsRegistry):
+        self._registry = registry
+
+    def _value(self, name: str) -> float:
+        fam = self._registry.get(name)
+        return fam.value if fam is not None and not fam.label_names else 0.0
+
+    @property
+    def traces(self) -> int:
+        return int(self._value("das_jax_traces_total"))
+
+    @property
+    def compiles(self) -> int:
+        return int(self._value("das_jax_compiles_total"))
+
+    @property
+    def compile_seconds(self) -> float:
+        return self._value("das_jax_compile_seconds_total")
+
+
+def install(registry: MetricsRegistry) -> CompileWatch:
+    """Subscribe ``registry`` to monitoring events; the counters exist (at
+    zero) from this call on.  Subscriptions are ref-counted: events fan
+    out once per registry however many times it is installed, and the
+    registry stays subscribed until every install is matched by an
+    :func:`uninstall`."""
+    _ensure_listener()
+    # pre-register so a scrape before the first event still shows the family
+    registry.counter("das_jax_traces_total",
+                     "jaxpr traces (fresh jit lowerings)")
+    registry.counter("das_jax_compiles_total", "XLA backend compiles")
+    registry.counter("das_jax_compile_seconds_total",
+                     "summed backend compile time")
+    with _lock:
+        _subscribers[registry] = _subscribers.get(registry, 0) + 1
+    return CompileWatch(registry)
+
+
+def uninstall(registry: MetricsRegistry) -> None:
+    """Release one :func:`install` of ``registry``; the fan-out drops it
+    when the last subscription is released (its counters keep their
+    values).  A no-op for a registry that was never installed."""
+    with _lock:
+        n = _subscribers.get(registry, 0)
+        if n <= 1:
+            _subscribers.pop(registry, None)
+        else:
+            _subscribers[registry] = n - 1

@@ -61,6 +61,8 @@ except ImportError:                     # pragma: no cover - older jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from das_diff_veh_tpu.config import RingConfig
+from das_diff_veh_tpu.obs.profiling import register_memory_gauges
+from das_diff_veh_tpu.obs.registry import MetricsRegistry, default_registry
 from das_diff_veh_tpu.ops.pallas_xcorr import (_decide_pallas,
                                                _resolve_lagmax_block,
                                                _resolve_win_block,
@@ -81,13 +83,31 @@ def _sharded_window_spectra(data, wlen: int, overlap_ratio: float, spec):
         _window_spectra(data, wlen, overlap_ratio), spec)
 
 
+def _observe_ring_build(mesh: Mesh, ring: RingConfig,
+                        registry: MetricsRegistry | None) -> None:
+    """Register this engine's host-side observability: a build counter
+    labeled by decomposition mode (this code runs at trace time under jit,
+    so it counts ring *programs built*, not loop steps — the in-loop truth
+    is the profiler's job), the mesh size, and lazy per-device
+    ``memory_stats()`` gauges (the bench.py peak-bytes pattern, scrapable
+    while a ring program runs)."""
+    reg = registry if registry is not None else default_registry()
+    reg.counter("das_ring_builds_total",
+                "all-pairs ring programs traced, by decomposition",
+                labels=("mode",)).labels(mode=ring.mode).inc()
+    reg.gauge("das_ring_devices", "mesh size of the last ring build").set(
+        int(mesh.devices.size))
+    register_memory_gauges(reg, list(mesh.devices.flat))
+
+
 def sharded_all_pairs_peak(data: jnp.ndarray, wlen: int, mesh: Mesh, *,
                            axis: str = "win", overlap_ratio: float = 0.5,
                            src_chunk: int = 64,
                            use_pallas: bool | None = None,
                            interpret: bool = False,
                            win_block: int | None = None,
-                           ring: RingConfig | None = None) -> jnp.ndarray:
+                           ring: RingConfig | None = None,
+                           registry: MetricsRegistry | None = None) -> jnp.ndarray:
     """Per-pair peak |xcorr| (nch, nch) computed as a ring pipeline over
     ``mesh``'s ``axis``.  On the kernel path this matches
     ``xcorr_all_pairs_peak`` bit-for-bit — the in-kernel window
@@ -105,6 +125,7 @@ def sharded_all_pairs_peak(data: jnp.ndarray, wlen: int, mesh: Mesh, *,
     if ring.mode not in ("ring", "replicated"):
         raise ValueError(f"RingConfig.mode must be 'ring' or 'replicated', "
                          f"got {ring.mode!r}")
+    _observe_ring_build(mesh, ring, registry)
     _resolve_win_block(1, win_block)        # validate before any device work
     _resolve_lagmax_block(1, False, ring.lagmax_block)
     nch = data.shape[0]

@@ -17,7 +17,7 @@ import argparse
 import json
 import logging
 
-from das_diff_veh_tpu.config import ImagingConfig, PipelineConfig
+from das_diff_veh_tpu.config import ImagingConfig, ObsConfig, PipelineConfig
 from das_diff_veh_tpu.pipeline.workflow import run_date_range
 from das_diff_veh_tpu.runtime import RuntimeConfig
 
@@ -53,6 +53,27 @@ def build_parser() -> argparse.ArgumentParser:
                     help="persistent XLA compilation cache "
                          "(jax_compilation_cache_dir): reruns and serve "
                          "warmups skip recompiles across process restarts")
+    obs = p.add_argument_group("observability",
+                               "metrics/flight/profiler knobs "
+                               "(docs/OBSERVABILITY.md)")
+    obs.add_argument("--metrics_jsonl", default=None, metavar="PATH",
+                     help="append periodic metrics-registry snapshots "
+                          "(JSON lines) here — the batch counterpart of the "
+                          "serve front's GET /metrics")
+    obs.add_argument("--metrics_interval", type=float, default=10.0,
+                     metavar="S", help="seconds between metrics snapshots")
+    obs.add_argument("--flight_dir", default=None, metavar="DIR",
+                     help="crash-flight-recorder dumps (recent per-chunk "
+                          "records as JSON on quarantine/SIGTERM); render "
+                          "with scripts/obs_report.py")
+    obs.add_argument("--profile_dir", default=None, metavar="DIR",
+                     help="capture a programmatic jax.profiler window of "
+                          "--profile_chunks steady-state chunks here")
+    obs.add_argument("--profile_chunks", type=int, default=2,
+                     help="chunks inside the profiler window")
+    obs.add_argument("--trace_flush_interval", type=float, default=0.0,
+                     metavar="S", help="batch trace writes, flushing every "
+                                       "S seconds (0 = flush per span)")
     return p
 
 
@@ -79,10 +100,16 @@ def main(argv=None) -> int:
         parser.error("--data_root/--start_date/--end_date are "
                      "required unless --figures is given")
     cfg = PipelineConfig().replace(imaging=ImagingConfig(x0=args.x0))
+    obs = ObsConfig(metrics_jsonl=args.metrics_jsonl,
+                    metrics_interval_s=args.metrics_interval,
+                    flight_dir=args.flight_dir,
+                    profile_dir=args.profile_dir,
+                    profile_n_chunks=args.profile_chunks,
+                    trace_flush_interval_s=args.trace_flush_interval)
     runtime = RuntimeConfig(prefetch_depth=args.prefetch_depth,
                             max_retries=args.retries,
                             retry_backoff_s=args.retry_backoff,
-                            trace_path=args.trace)
+                            trace_path=args.trace, obs=obs)
     summary = run_date_range(args.data_root, args.start_date, args.end_date,
                              cfg=cfg, method=args.method, out_dir=args.out_dir,
                              n_min_save=args.n_min_save,

@@ -33,6 +33,9 @@ import numpy as np
 from das_diff_veh_tpu.config import PipelineConfig
 from das_diff_veh_tpu.core.section import DasSection
 from das_diff_veh_tpu.io.readers import DirectoryDataset
+from das_diff_veh_tpu.obs import (FlightRecorder, HBMSampler, MetricsSink,
+                                  ProfilerWindow, default_registry,
+                                  register_memory_gauges, xla_events)
 from das_diff_veh_tpu.pipeline.timelapse import process_chunk
 from das_diff_veh_tpu.runtime import (ChunkTask, RunManifest, RuntimeConfig,
                                       config_hash, make_tracer, run_pipelined)
@@ -148,163 +151,222 @@ def run_directory(dataset: DirectoryDataset, cfg: Optional[PipelineConfig] = Non
     cfg = cfg if cfg is not None else PipelineConfig()
     runtime = runtime if runtime is not None else RuntimeConfig()
     own_tracer = tracer is None
-    tracer = tracer if tracer is not None else make_tracer(runtime.trace_path)
+    obs_cfg = runtime.obs
+    tracer = tracer if tracer is not None else make_tracer(
+        runtime.trace_path,
+        flush_interval_s=obs_cfg.trace_flush_interval_s)
     res = DirectoryResult()
     date = dataset.directory
     t_start = time.perf_counter()
 
-    # --- manifest: load-or-invalidate, restore partial state ----------------
-    chash = _run_config_hash(cfg, method, x_is_channels, dataset)
-    manifest: Optional[RunManifest] = None
-    acc: Optional[np.ndarray] = None
-    done: dict = {}                      # key -> n_windows, in processed order
-    if out_dir:
-        manifest = RunManifest.load(_manifest_path(out_dir, date))
-        if manifest is not None and manifest.config_hash != chash:
-            log.warning("%s: config hash changed (%s -> %s); stale outputs "
-                        "invalidated, reprocessing", date,
-                        manifest.config_hash, chash)
-            manifest = None
-        st = _load_state(out_dir, date, chash)
-        if manifest is not None and st is not None:
-            acc, done = st
-        if manifest is None:
-            manifest = RunManifest(path=_manifest_path(out_dir, date),
-                                   config_hash=chash, date=date)
-        # reconcile: the state checkpoint is authoritative for done chunks
-        # (quarantine records stay manifest-side; a done entry the state
-        # never absorbed is dropped and recomputed)
-        for k in list(manifest.files):
-            if manifest.files[k]["status"] == "done" and k not in done:
-                del manifest.files[k]
-        for k, n in done.items():
-            manifest.mark_done(k, n)
-        manifest.complete = False
-        manifest.save()
-        res.n_resumed = sum(1 for p in dataset.files
-                            if manifest.is_settled(os.path.basename(p)))
-        if res.n_resumed:
-            log.info("%s: resuming — %d/%d chunks already settled", date,
-                     res.n_resumed, len(dataset.files))
-    state = {"n_vehicles": sum(done.values()),
-             "n_chunks": sum(1 for n in done.values() if n > 0)}
+    # --- observability: one registry, a flight ring, optional sink/profiler --
+    # Batch runs register into the process-default registry so a serve front
+    # (or anything else) in the same process scrapes runtime metrics too;
+    # the JSONL sink is the scrapeless equivalent for offline runs.
+    # ObsConfig.enabled=False (the bench A/B's bare side) turns the whole
+    # stack off: every handle below stays None and run_pipelined sees the
+    # same knob, so the instrumented path is genuinely absent, not no-op'd.
+    obs_on = obs_cfg.enabled
+    registry = flight = sink = profiler = hbm = None
+    xla_installed = signals_installed = False
 
-    # --- build the remaining work list --------------------------------------
-    settled = (manifest.is_settled if manifest is not None
-               else (lambda key: False))
-    remaining = [(i, p) for i, p in enumerate(dataset.files)
-                 if not settled(os.path.basename(p))]
-    truncated = max_chunks is not None and len(remaining) > max_chunks
-    if truncated:
-        remaining = remaining[:max_chunks]
-
-    split_load = hasattr(dataset, "read") and hasattr(dataset, "preprocess")
-
-    def make_task(i: int, path: str) -> ChunkTask:
-        # index = absolute position in dataset.files, so snapshot tags and
-        # progress logs stay truthful across resumed runs
-        key = os.path.basename(path)
-
-        def load() -> DasSection:
-            if split_load:
-                with tracer.span("read", file=key):
-                    sec = dataset.read(i)
-                with tracer.span("preprocess", file=key):
-                    sec = dataset.preprocess(sec, i)
-            else:
-                with tracer.span("read", file=key):
-                    sec = dataset[i]
-            if runtime.device_put:
-                with tracer.span("device_put", file=key):
-                    sec = DasSection(jax.device_put(np.asarray(sec.data)),
-                                     sec.x, sec.t)
-            return sec
-
-        return ChunkTask(index=i, key=key, load=load)
-
-    tasks = [make_task(i, p) for i, p in remaining]
-
-    # --- snapshot cadence (reference n_min_save, imaging_workflow.py:68-74) --
+    # everything below may raise (a sink open against a bad path, disk-full
+    # checkpoints, compute errors escaping the retry budget); the obs stack
+    # and the owned tracer must not leak past this run either way, so even
+    # the obs constructors live inside the try
     try:
-        interval_s = dataset.time_interval()
-    except ValueError:
-        interval_s = n_min_save * 60.0
-    n_win_save = max(int(n_min_save * 60.0 / interval_s), 1)
-
-    # --- the three runtime callbacks ----------------------------------------
-    def _default_compute(section: DasSection):
-        chunk = process_chunk(section, cfg, method=method,
-                              x_is_channels=x_is_channels)
-        jax.block_until_ready(chunk.disp_image)
-        n = int(chunk.n_windows)
-        return n, (np.asarray(chunk.disp_image) if n > 0 else None)
-
-    chunk_fn = compute_fn if compute_fn is not None else _default_compute
-
-    def compute(section: DasSection):
-        tic = time.perf_counter()
-        n, img = chunk_fn(section)
-        return int(n), img, time.perf_counter() - tic
-
-    def checkpoint() -> None:
+        if obs_on:
+            registry = default_registry()
+            flight = FlightRecorder(capacity=obs_cfg.flight_capacity,
+                                    out_dir=obs_cfg.flight_dir,
+                                    name=f"flight_{date}")
+            if obs_cfg.metrics_jsonl:
+                sink = MetricsSink(registry, obs_cfg.metrics_jsonl,
+                                   obs_cfg.metrics_interval_s)
+            if obs_cfg.profile_dir:
+                profiler = ProfilerWindow(
+                    obs_cfg.profile_dir,
+                    start_after=obs_cfg.profile_start_chunk,
+                    n_steps=obs_cfg.profile_n_chunks, registry=registry)
+            if obs_cfg.xla_events:
+                xla_events.install(registry)
+                xla_installed = True
+            register_memory_gauges(registry)
+            if obs_cfg.hbm_sample_interval_s > 0:
+                hbm = HBMSampler(registry,
+                                 interval_s=obs_cfg.hbm_sample_interval_s)
+            if obs_cfg.flight_dir is not None:
+                signals_installed = flight.install_signal_handlers()
+        # --- manifest: load-or-invalidate, restore partial state ----------------
+        chash = _run_config_hash(cfg, method, x_is_channels, dataset)
+        if flight is not None:
+            flight.record("run", date=date, config_hash=chash, method=method,
+                          n_files=len(dataset.files))
+        manifest: Optional[RunManifest] = None
+        acc: Optional[np.ndarray] = None
+        done: dict = {}                      # key -> n_windows, in processed order
         if out_dir:
-            _save_state(out_dir, date, chash, acc, done)  # state first: truth
+            manifest = RunManifest.load(_manifest_path(out_dir, date))
+            if manifest is not None and manifest.config_hash != chash:
+                log.warning("%s: config hash changed (%s -> %s); stale outputs "
+                            "invalidated, reprocessing", date,
+                            manifest.config_hash, chash)
+                manifest = None
+            st = _load_state(out_dir, date, chash)
+            if manifest is not None and st is not None:
+                acc, done = st
+            if manifest is None:
+                manifest = RunManifest(path=_manifest_path(out_dir, date),
+                                       config_hash=chash, date=date)
+            # reconcile: the state checkpoint is authoritative for done chunks
+            # (quarantine records stay manifest-side; a done entry the state
+            # never absorbed is dropped and recomputed)
+            for k in list(manifest.files):
+                if manifest.files[k]["status"] == "done" and k not in done:
+                    del manifest.files[k]
+            for k, n in done.items():
+                manifest.mark_done(k, n)
+            manifest.complete = False
             manifest.save()
+            res.n_resumed = sum(1 for p in dataset.files
+                                if manifest.is_settled(os.path.basename(p)))
+            if res.n_resumed:
+                log.info("%s: resuming — %d/%d chunks already settled", date,
+                         res.n_resumed, len(dataset.files))
+        state = {"n_vehicles": sum(done.values()),
+                 "n_chunks": sum(1 for n in done.values() if n > 0)}
 
-    seq_done = {"n": 0}              # chunks accumulated THIS run
+        # --- build the remaining work list --------------------------------------
+        settled = (manifest.is_settled if manifest is not None
+                   else (lambda key: False))
+        remaining = [(i, p) for i, p in enumerate(dataset.files)
+                     if not settled(os.path.basename(p))]
+        truncated = max_chunks is not None and len(remaining) > max_chunks
+        if truncated:
+            remaining = remaining[:max_chunks]
 
-    def accumulate(task: ChunkTask, result) -> None:
-        nonlocal acc
-        n, img, dt_chunk = result
-        if n > 0:
-            acc = img if acc is None else acc + img
-            state["n_vehicles"] += n
-            state["n_chunks"] += 1
-        done[task.key] = n
-        if manifest is not None:
-            manifest.mark_done(task.key, n)
-        seq_done["n"] += 1
-        log.info("chunk %s (%d/%d): %d windows, %.2fs", task.key,
-                 task.index + 1, len(dataset.files), n, dt_chunk)
-        tracer.counter("vehicles", total=state["n_vehicles"])
-        if seq_done["n"] % runtime.state_every == 0 or seq_done["n"] == len(tasks):
+        split_load = hasattr(dataset, "read") and hasattr(dataset, "preprocess")
+
+        def make_task(i: int, path: str) -> ChunkTask:
+            # index = absolute position in dataset.files, so snapshot tags and
+            # progress logs stay truthful across resumed runs
+            key = os.path.basename(path)
+
+            def load() -> DasSection:
+                if split_load:
+                    with tracer.span("read", file=key):
+                        sec = dataset.read(i)
+                    with tracer.span("preprocess", file=key):
+                        sec = dataset.preprocess(sec, i)
+                else:
+                    with tracer.span("read", file=key):
+                        sec = dataset[i]
+                if runtime.device_put:
+                    with tracer.span("device_put", file=key):
+                        sec = DasSection(jax.device_put(np.asarray(sec.data)),
+                                         sec.x, sec.t)
+                return sec
+
+            return ChunkTask(index=i, key=key, load=load)
+
+        tasks = [make_task(i, p) for i, p in remaining]
+
+        # --- snapshot cadence (reference n_min_save, imaging_workflow.py:68-74) --
+        try:
+            interval_s = dataset.time_interval()
+        except ValueError:
+            interval_s = n_min_save * 60.0
+        n_win_save = max(int(n_min_save * 60.0 / interval_s), 1)
+
+        # --- the three runtime callbacks ----------------------------------------
+        def _default_compute(section: DasSection):
+            chunk = process_chunk(section, cfg, method=method,
+                                  x_is_channels=x_is_channels)
+            jax.block_until_ready(chunk.disp_image)
+            n = int(chunk.n_windows)
+            return n, (np.asarray(chunk.disp_image) if n > 0 else None)
+
+        chunk_fn = compute_fn if compute_fn is not None else _default_compute
+
+        def compute(section: DasSection):
+            tic = time.perf_counter()
+            n, img = chunk_fn(section)
+            return int(n), img, time.perf_counter() - tic
+
+        def checkpoint() -> None:
+            if out_dir:
+                _save_state(out_dir, date, chash, acc, done)  # state first: truth
+                manifest.save()
+
+        seq_done = {"n": 0}              # chunks accumulated THIS run
+
+        def accumulate(task: ChunkTask, result) -> None:
+            nonlocal acc
+            n, img, dt_chunk = result
+            if n > 0:
+                acc = img if acc is None else acc + img
+                state["n_vehicles"] += n
+                state["n_chunks"] += 1
+            done[task.key] = n
+            if manifest is not None:
+                manifest.mark_done(task.key, n)
+            seq_done["n"] += 1
+            log.info("chunk %s (%d/%d): %d windows, %.2fs", task.key,
+                     task.index + 1, len(dataset.files), n, dt_chunk)
+            tracer.counter("vehicles", total=state["n_vehicles"])
+            if profiler is not None:
+                profiler.step()         # opens/closes the steady-state window
+            if seq_done["n"] % runtime.state_every == 0 or \
+                    seq_done["n"] == len(tasks):
+                checkpoint()
+            if out_dir and acc is not None and \
+                    (task.index == 0 or (task.index + 1) % n_win_save == 0):
+                _save_snapshot(out_dir, date, acc, state["n_vehicles"],
+                               tag=f"win{task.index + 1}")
+                res.checkpoints.append(task.index + 1)
+
+        def on_quarantine(rec) -> None:
+            if manifest is not None:
+                manifest.mark_quarantined(rec.key, rec.stage, rec.error,
+                                          rec.retries)
             checkpoint()
-        if out_dir and acc is not None and \
-                (task.index == 0 or (task.index + 1) % n_win_save == 0):
-            _save_snapshot(out_dir, date, acc, state["n_vehicles"],
-                           tag=f"win{task.index + 1}")
-            res.checkpoints.append(task.index + 1)
 
-    def on_quarantine(rec) -> None:
+        n_veh0 = state["n_vehicles"]
+        stats = run_pipelined(tasks, compute, accumulate, cfg=runtime,
+                              tracer=tracer, on_quarantine=on_quarantine,
+                              registry=registry, flight=flight)
+
+        # --- completion + result ---------------------------------------------
+        res.avg_image = acc
+        res.n_vehicles = state["n_vehicles"]
+        res.n_chunks = state["n_chunks"]
+        res.quarantined = list(stats.quarantined)
+        res.n_retries = stats.n_retries
+        res.complete = not truncated
         if manifest is not None:
-            manifest.mark_quarantined(rec.key, rec.stage, rec.error,
-                                      rec.retries)
-        checkpoint()
-
-    n_veh0 = state["n_vehicles"]
-    stats = run_pipelined(tasks, compute, accumulate, cfg=runtime,
-                          tracer=tracer, on_quarantine=on_quarantine)
-
-    # --- completion + result -------------------------------------------------
-    res.avg_image = acc
-    res.n_vehicles = state["n_vehicles"]
-    res.n_chunks = state["n_chunks"]
-    res.quarantined = list(stats.quarantined)
-    res.n_retries = stats.n_retries
-    res.complete = not truncated
-    if manifest is not None:
-        res.complete = res.complete and all(
-            manifest.is_settled(os.path.basename(p)) for p in dataset.files)
-        manifest.complete = res.complete
-        checkpoint()
-    res.wall_s = time.perf_counter() - t_start
-    n_processed = stats.n_done + len(stats.quarantined)
-    if stats.wall_s > 0 and n_processed:
-        res.chunks_per_s = n_processed / stats.wall_s
-        res.vehicles_per_s = (state["n_vehicles"] - n_veh0) / stats.wall_s
-    if own_tracer:
-        tracer.close()
-    return res
+            res.complete = res.complete and all(
+                manifest.is_settled(os.path.basename(p)) for p in dataset.files)
+            manifest.complete = res.complete
+            checkpoint()
+        res.wall_s = time.perf_counter() - t_start
+        n_processed = stats.n_done + len(stats.quarantined)
+        if stats.wall_s > 0 and n_processed:
+            res.chunks_per_s = n_processed / stats.wall_s
+            res.vehicles_per_s = (state["n_vehicles"] - n_veh0) / stats.wall_s
+        return res
+    finally:
+        if profiler is not None:
+            profiler.close()        # stop a window the run ended inside
+        if hbm is not None:
+            hbm.close()
+        if sink is not None:
+            sink.close()            # final snapshot line
+        if xla_installed:
+            xla_events.uninstall(registry)
+        if signals_installed:
+            flight.uninstall_signal_handlers()
+        if own_tracer:
+            tracer.close()
 
 
 def _save_snapshot(out_dir: str, date: str, avg_image: np.ndarray,
@@ -334,7 +396,8 @@ def run_date_range(root: str, start_date: str, end_date: str,
     """
     cfg = cfg if cfg is not None else PipelineConfig()
     runtime = runtime if runtime is not None else RuntimeConfig()
-    tracer = make_tracer(runtime.trace_path)
+    tracer = make_tracer(runtime.trace_path,
+                         flush_interval_s=runtime.obs.trace_flush_interval_s)
     summary = {}
     try:
         for date in date_range(start_date, end_date):

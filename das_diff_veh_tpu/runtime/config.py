@@ -8,8 +8,10 @@ excluded from the resume manifest's config hash.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Optional
+
+from das_diff_veh_tpu.config import ObsConfig
 
 
 @dataclass(frozen=True)
@@ -41,3 +43,9 @@ class RuntimeConfig:
     trace_path: Optional[str] = None
     """Write Chrome-trace-format JSONL span events here (read / preprocess /
     compute / accumulate, plus throughput counters).  None disables."""
+
+    obs: ObsConfig = field(default_factory=ObsConfig)
+    """Observability knobs for the batch run: metrics JSONL sink,
+    flight-recorder dumps on quarantine/SIGTERM, the steady-state profiler
+    window, trace flush batching (see :class:`~das_diff_veh_tpu.config.ObsConfig`
+    and docs/OBSERVABILITY.md)."""

@@ -19,11 +19,37 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional, Sequence
 
+from das_diff_veh_tpu.obs.flight import FlightRecorder
+from das_diff_veh_tpu.obs.registry import MetricsRegistry, default_registry
 from das_diff_veh_tpu.runtime.config import RuntimeConfig
 from das_diff_veh_tpu.runtime.prefetch import PrefetchLoader
 from das_diff_veh_tpu.runtime.tracing import NullTracer
 
 log = logging.getLogger("das_diff_veh_tpu.runtime")
+
+
+class _NullObs:
+    """No-op stand-in for the metric families and the flight recorder when
+    ``ObsConfig.enabled`` is False (the bench ``obs_overhead`` A/B's bare
+    side): the hot loop stays branch-free while paying literally nothing."""
+
+    def labels(self, **kv):
+        return self
+
+    def inc(self, by: float = 1.0) -> None:
+        pass
+
+    def observe(self, value: float) -> None:
+        pass
+
+    def record(self, kind: str, **fields) -> None:
+        pass
+
+    def dump(self, reason: str, **context) -> None:
+        return None
+
+
+_NULL_OBS = _NullObs()
 
 
 @dataclass
@@ -83,17 +109,47 @@ def run_pipelined(tasks: Sequence[ChunkTask],
                   cfg: Optional[RuntimeConfig] = None,
                   tracer=None,
                   on_quarantine: Optional[Callable[[QuarantineRecord], None]] = None,
+                  registry: Optional[MetricsRegistry] = None,
+                  flight: Optional[FlightRecorder] = None,
                   ) -> ExecStats:
     """Execute every task; never raises for a per-chunk failure.
 
     ``compute`` runs device work for one loaded value; ``accumulate`` folds
     its result into caller state (called in task order).  ``on_quarantine``
     fires once per permanently-failed chunk (manifest bookkeeping).
+
+    Chunk progress, retries, quarantines, per-chunk wall time, and the live
+    prefetch queue depth register as ``das_runtime_*`` families into
+    ``registry`` (default: the process registry, so a serve front in the
+    same process scrapes them); per-chunk records land in ``flight`` and a
+    quarantine dumps the ring (the post-mortem artifact).
     """
     cfg = cfg or RuntimeConfig()
     tracer = tracer or NullTracer()
+    # an explicit registry/flight is intent enough to instrument; otherwise
+    # ObsConfig.enabled=False (the bench A/B's bare side) skips everything
+    obs_on = cfg.obs.enabled or registry is not None or flight is not None
+    depth_gauge = None
+    if obs_on:
+        reg = registry if registry is not None else default_registry()
+        flight = flight if flight is not None else FlightRecorder(
+            capacity=cfg.obs.flight_capacity, out_dir=cfg.obs.flight_dir,
+            name="runtime_flight")
+        c_chunks = reg.counter("das_runtime_chunks_total",
+                               "chunks by terminal status", labels=("status",))
+        c_retries = reg.counter("das_runtime_retries_total",
+                                "per-stage retry attempts", labels=("stage",))
+        h_chunk = reg.histogram("das_runtime_chunk_seconds",
+                                "wall seconds per completed chunk")
+    else:
+        flight = _NULL_OBS
+        c_chunks = c_retries = h_chunk = _NULL_OBS
     stats = ExecStats()
     loader = PrefetchLoader([t.load for t in tasks], depth=cfg.prefetch_depth)
+    if obs_on:
+        depth_gauge = reg.gauge("das_runtime_prefetch_depth",
+                                "chunks staged ahead by the loader")
+        depth_gauge.set_fn(loader.qsize)
     t_start = time.perf_counter()
     try:
         pending = iter(loader)
@@ -104,6 +160,7 @@ def run_pipelined(tasks: Sequence[ChunkTask],
                 break
             idx, value, err = nxt
             task = tasks[idx]
+            t_chunk0 = time.perf_counter()
             retries = 0
             if err is not None:
                 # the prefetched attempt was attempt 0; retry inline from 1
@@ -111,11 +168,17 @@ def run_pipelined(tasks: Sequence[ChunkTask],
                 value, err, retries = _retrying(task.load, "load", task.key,
                                                 cfg, tracer, stats,
                                                 prior_error=err)
+                if retries:
+                    c_retries.labels(stage="load").inc(retries)
             if err is not None:
                 rec = QuarantineRecord(task.key, "load", f"{type(err).__name__}: {err}",
                                        retries)
                 stats.quarantined.append(rec)
                 log.error("%s: quarantined after load failure: %s", task.key, rec.error)
+                c_chunks.labels(status="quarantined").inc()
+                flight.record("chunk", key=task.key, stage="load",
+                              error=rec.error, retries=retries)
+                flight.dump("quarantine", key=task.key, stage="load")
                 if on_quarantine:
                     on_quarantine(rec)
                 continue
@@ -126,12 +189,18 @@ def run_pipelined(tasks: Sequence[ChunkTask],
 
             result, err, retries = _retrying(_compute, "compute", task.key,
                                              cfg, tracer, stats)
+            if retries:
+                c_retries.labels(stage="compute").inc(retries)
             if err is not None:
                 rec = QuarantineRecord(task.key, "compute",
                                        f"{type(err).__name__}: {err}", retries)
                 stats.quarantined.append(rec)
                 log.error("%s: quarantined after compute failure: %s",
                           task.key, rec.error)
+                c_chunks.labels(status="quarantined").inc()
+                flight.record("chunk", key=task.key, stage="compute",
+                              error=rec.error, retries=retries)
+                flight.dump("quarantine", key=task.key, stage="compute")
                 if on_quarantine:
                     on_quarantine(rec)
                 continue
@@ -139,9 +208,19 @@ def run_pipelined(tasks: Sequence[ChunkTask],
             with tracer.span("accumulate", key=task.key):
                 accumulate(task, result)
             stats.n_done += 1
+            dt_chunk = time.perf_counter() - t_chunk0
+            c_chunks.labels(status="done").inc()
+            h_chunk.observe(dt_chunk)
+            flight.record("chunk", key=task.key, retries=retries,
+                          wall_s=round(dt_chunk, 4))
             tracer.counter("chunks", done=stats.n_done,
                            quarantined=len(stats.quarantined))
     finally:
         loader.close()
+        if depth_gauge is not None:
+            # replace the loader-bound callback with a plain 0 so the gauge
+            # (process-lifetime) stops pinning the loader and any staged
+            # sections its queue still holds after an aborted run
+            depth_gauge.set(0.0)
     stats.wall_s = time.perf_counter() - t_start
     return stats

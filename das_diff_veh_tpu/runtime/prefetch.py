@@ -79,6 +79,11 @@ class PrefetchLoader:
                 return
             yield item
 
+    def qsize(self) -> int:
+        """Chunks currently staged ahead (0 in inline mode) — the live
+        queue-depth gauge the obs registry scrapes."""
+        return self._queue.qsize() if self._queue is not None else 0
+
     def close(self) -> None:
         """Stop the loader early (executor abort); idempotent."""
         self._stop.set()

@@ -46,18 +46,32 @@ class NullTracer:
     def instant(self, name: str, **args) -> None:
         pass
 
+    def flush(self) -> None:
+        pass
+
     def close(self) -> None:
         pass
 
 
 class TraceWriter(NullTracer):
-    """Thread-safe Chrome-trace JSONL writer."""
+    """Thread-safe Chrome-trace JSONL writer.
 
-    def __init__(self, path: str, process_name: str = "das_diff_veh_tpu"):
+    ``flush_interval_s`` controls crash durability vs syscall cost: 0 (the
+    default) flushes after every event line, so a killed run keeps every
+    completed span; > 0 batches writes in the stdio buffer and flushes at
+    most once per interval (``ObsConfig.trace_flush_interval_s`` — tight
+    per-chunk loops stop paying one ``write`` syscall per span, an unclean
+    kill can lose up to one interval's events).  ``close`` always flushes.
+    """
+
+    def __init__(self, path: str, process_name: str = "das_diff_veh_tpu",
+                 flush_interval_s: float = 0.0):
         self.path = path
+        self.flush_interval_s = float(flush_interval_s)
         self._f = open(path, "w")
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
+        self._last_flush = time.perf_counter()
         self._named_tids: set = set()
         self._emit({"name": "process_name", "ph": "M", "ts": 0, "pid": 1,
                     "tid": 0, "args": {"name": process_name}})
@@ -80,7 +94,19 @@ class TraceWriter(NullTracer):
         with self._lock:
             if not self._f.closed:
                 self._f.write(line + "\n")
+                if self.flush_interval_s <= 0.0:
+                    self._f.flush()
+                else:
+                    now = time.perf_counter()
+                    if now - self._last_flush >= self.flush_interval_s:
+                        self._f.flush()
+                        self._last_flush = now
+
+    def flush(self) -> None:
+        with self._lock:
+            if not self._f.closed:
                 self._f.flush()
+                self._last_flush = time.perf_counter()
 
     # -- public API ----------------------------------------------------------
     def now_us(self) -> float:
@@ -126,8 +152,10 @@ class TraceWriter(NullTracer):
                 self._f.close()
 
 
-def make_tracer(path: Optional[str]) -> NullTracer:
-    return TraceWriter(path) if path else NullTracer()
+def make_tracer(path: Optional[str],
+                flush_interval_s: float = 0.0) -> NullTracer:
+    return (TraceWriter(path, flush_interval_s=flush_interval_s)
+            if path else NullTracer())
 
 
 def load_trace(path: str) -> List[dict]:

@@ -14,7 +14,9 @@ import argparse
 import json
 import logging
 
-from das_diff_veh_tpu.config import ImagingConfig, PipelineConfig, ServeConfig
+from das_diff_veh_tpu.config import (ImagingConfig, ObsConfig, PipelineConfig,
+                                     ServeConfig)
+from das_diff_veh_tpu.obs import default_registry
 from das_diff_veh_tpu.runtime.tracing import make_tracer
 from das_diff_veh_tpu.serve.engine import ServingEngine
 from das_diff_veh_tpu.serve.http import make_server
@@ -61,6 +63,17 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "across restarts")
     p.add_argument("--trace", default=None, metavar="PATH",
                    help="write Chrome-trace JSONL request spans to PATH")
+    obs = p.add_argument_group("observability",
+                               "registry/flight knobs (docs/OBSERVABILITY.md;"
+                               " Prometheus scrape is GET /metrics)")
+    obs.add_argument("--flight_dir", default=None, metavar="DIR",
+                     help="crash-flight-recorder dump directory (a JSON "
+                          "artifact of recent requests on shed/error)")
+    obs.add_argument("--trace_flush_interval", type=float, default=0.0,
+                     metavar="S", help="batch trace writes, flushing every S "
+                                       "seconds (0 = flush per span)")
+    obs.add_argument("--no_xla_events", action="store_true",
+                     help="skip the jax.monitoring compile counters")
     p.add_argument("--verbal", action="store_true", help="info-level logs")
     return p
 
@@ -70,16 +83,23 @@ def serve_main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO if args.verbal else logging.WARNING,
                         format="%(asctime)s %(name)s %(message)s")
     cfg = PipelineConfig().replace(imaging=ImagingConfig(x0=args.x0))
+    obs_cfg = ObsConfig(flight_dir=args.flight_dir,
+                        trace_flush_interval_s=args.trace_flush_interval,
+                        xla_events=not args.no_xla_events)
     serve_cfg = ServeConfig(
         buckets=args.buckets, max_batch=args.max_batch,
         max_queue=args.max_queue, batch_window_ms=args.batch_window_ms,
         default_deadline_ms=args.deadline_ms, warmup=not args.no_warmup,
-        compilation_cache_dir=args.compilation_cache_dir)
-    tracer = make_tracer(args.trace)
+        compilation_cache_dir=args.compilation_cache_dir, obs=obs_cfg)
+    tracer = make_tracer(args.trace,
+                         flush_interval_s=args.trace_flush_interval)
     factory = ImagingComputeFactory(cfg, method=args.method,
                                     x_is_channels=args.x_is_channels,
                                     fs=args.fs)
-    engine = ServingEngine(factory, serve_cfg, tracer=tracer)
+    # the process-default registry: ring/runtime metrics registered anywhere
+    # in this process land in the same GET /metrics scrape as das_serve_*
+    engine = ServingEngine(factory, serve_cfg, tracer=tracer,
+                           registry=default_registry())
     engine.start()
     server = make_server(engine, args.host, args.port)
     print(f"serving on http://{server.server_address[0]}"

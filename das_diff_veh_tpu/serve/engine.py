@@ -44,6 +44,10 @@ from typing import Any, Optional, Tuple
 
 from das_diff_veh_tpu.config import ServeConfig
 from das_diff_veh_tpu.core.section import DasSection
+from das_diff_veh_tpu.obs import xla_events
+from das_diff_veh_tpu.obs.flight import FlightRecorder
+from das_diff_veh_tpu.obs.profiling import HBMSampler
+from das_diff_veh_tpu.obs.registry import MetricsRegistry
 from das_diff_veh_tpu.runtime.tracing import NullTracer
 from das_diff_veh_tpu.serve.buckets import (Bucket, normalize_buckets,
                                             pad_section, pick_bucket)
@@ -102,12 +106,25 @@ class ServingEngine:
     """
 
     def __init__(self, factory: ComputeFactory,
-                 cfg: Optional[ServeConfig] = None, tracer=None):
+                 cfg: Optional[ServeConfig] = None, tracer=None,
+                 registry: Optional[MetricsRegistry] = None,
+                 flight: Optional[FlightRecorder] = None):
         self.cfg = cfg if cfg is not None else ServeConfig()
         self.buckets = normalize_buckets(self.cfg.buckets)
         self.factory = factory
         self.tracer = tracer if tracer is not None else NullTracer()
-        self._metrics = ServeMetrics(latency_window=self.cfg.latency_window)
+        # each engine defaults to its own registry (isolation); pass
+        # obs.default_registry() to join the process-wide scrape/sink —
+        # the serve CLI does, so runtime/parallel metrics ride /metrics too
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self._metrics = ServeMetrics(latency_window=self.cfg.latency_window,
+                                     registry=self.registry)
+        obs_cfg = self.cfg.obs
+        self.flight = flight if flight is not None else FlightRecorder(
+            capacity=obs_cfg.flight_capacity, out_dir=obs_cfg.flight_dir,
+            name="serve_flight")
+        self._compile_watch = None
+        self._hbm: Optional[HBMSampler] = None
         self.sessions = SessionStore()
         self.cache = CompiledFunctionCache(factory, self._metrics)
         self._queue: queue.Queue = queue.Queue(maxsize=self.cfg.max_queue)
@@ -126,11 +143,25 @@ class ServingEngine:
         if self.cfg.compilation_cache_dir:
             from das_diff_veh_tpu.cache import enable_compilation_cache
             enable_compilation_cache(cache_dir=self.cfg.compilation_cache_dir)
+        if self.cfg.obs.xla_events:
+            self._compile_watch = xla_events.install(self.registry)
+        if self.cfg.obs.hbm_sample_interval_s > 0:
+            self._hbm = HBMSampler(
+                self.registry, interval_s=self.cfg.obs.hbm_sample_interval_s)
         if self.cfg.warmup:
             with self.tracer.span("warmup", cat="serve",
                                   buckets=list(map(list, self.buckets))):
                 for b in self.buckets:
                     self.cache.warmup(b)
+        if self._compile_watch is not None:
+            # device-truth SLO gauge: jaxpr traces since warmup finished.
+            # The compiled-function cache's own hit/miss counters cannot see
+            # a compile that happens OUTSIDE the cache; jax.monitoring can.
+            watch, base = self._compile_watch, self._compile_watch.traces
+            self.registry.gauge(
+                "das_serve_steady_state_compiles",
+                "fresh jit traces since warmup (SLO: stays 0)",
+            ).set_fn(lambda: watch.traces - base)
         self._thread = threading.Thread(target=self._dispatch_loop,
                                         name="serve-dispatch", daemon=True)
         self._thread.start()
@@ -143,6 +174,12 @@ class ServingEngine:
         the queue after the dispatcher exits (the submit/close race) is
         failed with :class:`EngineClosedError` rather than left hanging."""
         self._closed.set()
+        if self._compile_watch is not None:
+            xla_events.uninstall(self.registry)
+            self._compile_watch = None
+        if self._hbm is not None:
+            self._hbm.close()
+            self._hbm = None
         if self._thread is not None:
             self._thread.join(timeout=timeout)
             if self._thread.is_alive():
@@ -175,12 +212,14 @@ class ServingEngine:
         bucket = pick_bucket(valid, self.buckets)
         if bucket is None:
             self._metrics.inc("shed_no_bucket")
+            self._record_shed("no_bucket", valid, None, session)
             raise NoBucketError(
                 f"no bucket fits request shape {valid} "
                 f"(buckets: {list(self.buckets)})")
         reason = self.factory.validate(section, bucket)
         if reason is not None:
             self._metrics.inc("shed_invalid")
+            self._record_shed("invalid", valid, bucket, session, reason=reason)
             raise InvalidRequestError(reason)
         if deadline_ms is None:
             deadline_ms = self.cfg.default_deadline_ms
@@ -194,6 +233,7 @@ class ServingEngine:
         except queue.Full:
             self._metrics.inc("shed_rejected")
             self.tracer.instant("shed", cat="serve", reason="queue_full")
+            self._record_shed("queue_full", valid, bucket, session)
             raise QueueFullError(
                 f"admission queue full ({self.cfg.max_queue})") from None
         self._metrics.inc("submitted")
@@ -215,6 +255,15 @@ class ServingEngine:
         """Blocking convenience wrapper around :meth:`submit`."""
         return self.submit(section, deadline_ms, session).result(timeout)
 
+    def _record_shed(self, cause: str, valid, bucket, session,
+                     **fields) -> None:
+        """Flight-record one shed request and (rate-limited) dump — the
+        post-mortem artifact for 'why did production reject traffic'."""
+        self.flight.record("shed", cause=cause, shape=list(valid),
+                           bucket=list(bucket) if bucket else None,
+                           session=session, **fields)
+        self.flight.dump("shed", cause=cause)
+
     # -- introspection -------------------------------------------------------
     def metrics(self) -> dict:
         snap = self._metrics.snapshot()
@@ -232,6 +281,8 @@ class ServingEngine:
         self._metrics.inc("shed_expired")
         self.tracer.instant("shed", cat="serve", reason="deadline",
                             bucket=list(req.bucket))
+        self._record_shed("deadline", req.valid, req.bucket, req.session,
+                          queued_ms=(time.perf_counter() - req.t_submit) * 1e3)
         if not req.future.done():
             req.future.set_exception(DeadlineExceededError(
                 f"deadline passed after "
@@ -319,12 +370,20 @@ class ServingEngine:
             except Exception as e:
                 self._metrics.inc("errors")
                 log.exception("request failed in bucket %s", bucket)
+                self.flight.record("error", shape=list(req.valid),
+                                   bucket=list(bucket), session=req.session,
+                                   error=f"{type(e).__name__}: {e}")
+                self.flight.dump("error", bucket=list(bucket))
                 if not req.future.done():
                     req.future.set_exception(e)
                 continue
-            self._metrics.observe_request(
-                (t3 - req.t_submit) * 1e3,
-                {"queue": (t_dq - req.t_submit) * 1e3,
-                 "pad": (t1 - t0) * 1e3,
-                 "compute": (t2 - t1) * 1e3,
-                 "unpad": (t3 - t2) * 1e3})
+            stages = {"queue": (t_dq - req.t_submit) * 1e3,
+                      "pad": (t1 - t0) * 1e3,
+                      "compute": (t2 - t1) * 1e3,
+                      "unpad": (t3 - t2) * 1e3}
+            self._metrics.observe_request((t3 - req.t_submit) * 1e3, stages)
+            self.flight.record("request", shape=list(req.valid),
+                               bucket=list(bucket), session=req.session,
+                               total_ms=round((t3 - req.t_submit) * 1e3, 3),
+                               stages_ms={k: round(v, 3)
+                                          for k, v in stages.items()})

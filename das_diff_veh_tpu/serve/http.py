@@ -6,7 +6,11 @@ auth, JSON-array payloads, one engine per server):
 - ``POST /v1/process`` — body ``{"data": [[...]], "x": [...], "t": [...],
   "deadline_ms": opt, "session": opt}``; responds with the result summary
   (``?image=1`` to inline the full image values).
-- ``GET /v1/metrics`` — the engine's metrics snapshot.
+- ``GET /v1/metrics`` — the engine's legacy JSON metrics snapshot.
+- ``GET /metrics`` — Prometheus text exposition of the engine's registry
+  (``das_serve_*`` families, plus whatever else registered into the same
+  registry — the serve CLI passes the process default registry, so runtime
+  and parallel metrics ride the same scrape).
 - ``GET /healthz`` — liveness + configured buckets.
 
 Shed responses map onto HTTP status codes: 429 for backpressure
@@ -67,12 +71,15 @@ class ServeHandler(BaseHTTPRequestHandler):
         pass
 
     def _reply(self, code: int, payload: dict) -> None:
-        body = json.dumps(payload).encode()
+        self._reply_text(code, json.dumps(payload), "application/json")
+
+    def _reply_text(self, code: int, body: str, content_type: str) -> None:
+        raw = body.encode()
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(raw)))
         self.end_headers()
-        self.wfile.write(body)
+        self.wfile.write(raw)
 
     def do_GET(self):
         path = urlparse(self.path).path
@@ -82,6 +89,9 @@ class ServeHandler(BaseHTTPRequestHandler):
                                           self.engine.buckets]})
         elif path == "/v1/metrics":
             self._reply(200, self.engine.metrics())
+        elif path == "/metrics":
+            self._reply_text(200, self.engine.registry.prometheus_text(),
+                             "text/plain; version=0.0.4; charset=utf-8")
         else:
             self._reply(404, {"error": f"unknown path {path}"})
 

@@ -1,99 +1,129 @@
-"""Thread-safe serving metrics: counters, latency percentiles, occupancy.
+"""Serving metrics as a thin view over the shared obs registry.
 
-One registry per engine.  Counters are plain monotonic ints; completed
-request latencies (and their per-stage spans) go into bounded rings so the
-snapshot's p50/p95/p99 reflect recent traffic without unbounded memory.
-``snapshot()`` returns one JSON-ready dict — the engine's metrics API and
-the HTTP ``/metrics`` endpoint both serve it verbatim.
+Historically this module owned its own counter dict and latency deques; it
+is now a facade over :class:`das_diff_veh_tpu.obs.MetricsRegistry` — the
+same families the serve HTTP front exposes as Prometheus text on
+``GET /metrics`` back the legacy JSON ``snapshot()`` served on
+``/v1/metrics``, so the two surfaces can never disagree.  Registered
+families (``das_serve_*``):
+
+- ``das_serve_events_total{event=...}`` — the legacy counter set
+  (submitted/completed/errors/shed_*/cache_*/warmup_builds);
+- ``das_serve_latency_ms`` — total-latency ring (p50/p95/p99);
+- ``das_serve_stage_ms{stage=...}`` — per-stage rings.  Stages now report
+  the same percentile set as totals (they used to report only means; the
+  mean is kept in the snapshot for continuity);
+- ``das_serve_batches_total`` / ``das_serve_batched_requests_total`` /
+  ``das_serve_batch_max_occupancy`` — microbatch accounting;
+- ``das_serve_queue_depth`` — live depth via a collect-time callback.
+
+Each engine defaults to its OWN registry (tests and embedded engines stay
+isolated); the serve CLI passes ``obs.default_registry()`` so runtime and
+parallel metrics ride the same scrape — the "one registry" contract.
 """
 
 from __future__ import annotations
 
-import threading
-from collections import deque
 from typing import Dict, Optional
 
+from das_diff_veh_tpu.obs.registry import MetricsRegistry, percentile
 
-def _percentile(sorted_vals, q: float) -> float:
-    """Nearest-rank percentile of an already-sorted sequence."""
-    if not sorted_vals:
-        return 0.0
-    idx = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
-    return float(sorted_vals[idx])
+# bench.py and tests import the historical name
+_percentile = percentile
 
 
 class ServeMetrics:
     """Counters + bounded latency reservoirs for one serving engine."""
 
     _STAGES = ("queue", "pad", "compute", "unpad")
+    _COUNTS = ("submitted", "completed", "errors",
+               "shed_rejected", "shed_expired", "shed_no_bucket",
+               "shed_invalid", "cache_hits", "cache_misses", "warmup_builds")
 
-    def __init__(self, latency_window: int = 1024):
-        self._lock = threading.Lock()
-        self._counts: Dict[str, int] = {
-            "submitted": 0, "completed": 0, "errors": 0,
-            "shed_rejected": 0, "shed_expired": 0, "shed_no_bucket": 0,
-            "shed_invalid": 0,
-            "cache_hits": 0, "cache_misses": 0, "warmup_builds": 0,
-        }
-        self._latency = deque(maxlen=latency_window)       # total ms
-        self._stage = {s: deque(maxlen=latency_window) for s in self._STAGES}
-        self._batches = 0
-        self._batched_requests = 0
-        self._max_occupancy = 0
-        self._queue_depth_fn = None
+    def __init__(self, latency_window: int = 1024,
+                 registry: Optional[MetricsRegistry] = None):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self._window = latency_window
+        self._events = self.registry.counter(
+            "das_serve_events_total", "serving engine events by type",
+            labels=("event",))
+        for name in self._COUNTS:       # pre-touch: stable snapshot/scrape
+            self._events.labels(event=name)
+        self._latency = self.registry.histogram(
+            "das_serve_latency_ms", "total request latency [ms]",
+            window=latency_window)
+        self._stage = self.registry.histogram(
+            "das_serve_stage_ms", "per-stage request latency [ms]",
+            labels=("stage",), window=latency_window)
+        for s in self._STAGES:
+            self._stage.labels(stage=s)
+        self._batches = self.registry.counter(
+            "das_serve_batches_total", "microbatches executed")
+        self._batched = self.registry.counter(
+            "das_serve_batched_requests_total", "requests executed in batches")
+        self._max_occ = self.registry.gauge(
+            "das_serve_batch_max_occupancy", "largest microbatch so far")
+        self._depth = self.registry.gauge(
+            "das_serve_queue_depth", "requests waiting (queue + stash)")
 
     # -- write side (engine threads) -----------------------------------------
     def inc(self, name: str, by: int = 1) -> None:
-        with self._lock:
-            self._counts[name] = self._counts.get(name, 0) + by
+        self._events.labels(event=name).inc(by)
 
     def observe_batch(self, occupancy: int) -> None:
-        with self._lock:
-            self._batches += 1
-            self._batched_requests += occupancy
-            self._max_occupancy = max(self._max_occupancy, occupancy)
+        self._batches.inc()
+        self._batched.inc(occupancy)
+        if occupancy > self._max_occ.value:
+            self._max_occ.set(occupancy)
 
     def observe_request(self, total_ms: float,
                         stages_ms: Optional[Dict[str, float]] = None) -> None:
-        with self._lock:
-            self._counts["completed"] += 1
-            self._latency.append(total_ms)
-            for name, v in (stages_ms or {}).items():
-                self._stage.setdefault(
-                    name, deque(maxlen=self._latency.maxlen)).append(v)
+        self._events.labels(event="completed").inc()
+        self._latency.observe(total_ms)
+        for name, v in (stages_ms or {}).items():
+            self._stage.labels(stage=name).observe(v)
 
     def bind_queue_depth(self, fn) -> None:
         """Register a zero-arg callable reporting the live queue depth."""
-        self._queue_depth_fn = fn
+        self._depth.set_fn(fn)
 
     # -- read side -----------------------------------------------------------
     def count(self, name: str) -> int:
-        with self._lock:
-            return self._counts.get(name, 0)
+        return int(self._events.labels(event=name).value)
+
+    def _stage_snapshot(self, child) -> dict:
+        vals = child.values()
+        return {
+            "n": len(vals),
+            "mean": round(sum(vals) / len(vals), 3) if vals else 0.0,
+            "p50": round(percentile(vals, 0.50), 3),
+            "p95": round(percentile(vals, 0.95), 3),
+            "p99": round(percentile(vals, 0.99), 3),
+        }
 
     def snapshot(self) -> dict:
-        with self._lock:
-            lat = sorted(self._latency)
-            snap = {
-                **self._counts,
-                "queue_depth": self._queue_depth_fn() if self._queue_depth_fn else 0,
-                "latency_ms": {
-                    "n": len(lat),
-                    "p50": round(_percentile(lat, 0.50), 3),
-                    "p95": round(_percentile(lat, 0.95), 3),
-                    "p99": round(_percentile(lat, 0.99), 3),
-                    "max": round(lat[-1], 3) if lat else 0.0,
-                },
-                "stages_ms": {
-                    name: round(sum(ring) / len(ring), 3) if ring else 0.0
-                    for name, ring in self._stage.items()
-                },
-                "batch": {
-                    "count": self._batches,
-                    "mean_occupancy": round(
-                        self._batched_requests / self._batches, 3)
-                        if self._batches else 0.0,
-                    "max_occupancy": self._max_occupancy,
-                },
-            }
+        lat = self._latency.values()
+        batches = int(self._batches.value)
+        snap = {
+            **{event: int(child.value)
+               for (event,), child in self._events.children()},
+            "queue_depth": int(self._depth.value),
+            "latency_ms": {
+                "n": len(lat),
+                "p50": round(percentile(lat, 0.50), 3),
+                "p95": round(percentile(lat, 0.95), 3),
+                "p99": round(percentile(lat, 0.99), 3),
+                "max": round(lat[-1], 3) if lat else 0.0,
+            },
+            "stages_ms": {
+                stage: self._stage_snapshot(child)
+                for (stage,), child in self._stage.children()
+            },
+            "batch": {
+                "count": batches,
+                "mean_occupancy": round(
+                    self._batched.value / batches, 3) if batches else 0.0,
+                "max_occupancy": int(self._max_occ.value),
+            },
+        }
         return snap

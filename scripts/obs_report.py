@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Join observability artifacts into one human-readable post-mortem report.
+
+    python scripts/obs_report.py \
+        --flight results/flight_20230301_quarantine_1234_0.json \
+        --trace results/run_trace.jsonl \
+        --metrics results/metrics.jsonl
+
+Any subset of the three artifact kinds may be given (``--flight`` accepts
+several paths); the report renders what it gets:
+
+- **flight** — dump reason/context, then the ring of recent records with
+  error/shed/quarantine records flagged;
+- **trace** — Chrome-trace spans aggregated by name (count, total/mean/max
+  ms) so the hot stage is visible without opening Perfetto;
+- **metrics** — the LAST registry snapshot line (counters, gauges,
+  histogram percentiles), plus how many snapshots the run wrote.
+
+Where a flight record carries a chunk ``key``, the trace section's
+per-name aggregation is joined by a per-key roll-up for the keys that
+appear in failed flight records, so "what was the runtime doing to this
+chunk" reads in one place.  Exit code is 0 when every given artifact
+parsed, 2 otherwise (the verify recipe runs this against a smoke run's
+artifacts).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from collections import defaultdict
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from das_diff_veh_tpu.obs.flight import load_flight_dump  # noqa: E402
+from das_diff_veh_tpu.obs.sink import load_metrics_jsonl  # noqa: E402
+from das_diff_veh_tpu.runtime.tracing import load_trace  # noqa: E402
+
+_FAIL_KINDS = ("error", "shed", "quarantine")
+
+
+def _fmt_ms(us: float) -> str:
+    return f"{us / 1e3:.2f}"
+
+
+def render_flight(payload: dict, lines: list) -> list:
+    """Render one flight dump; returns the chunk/request keys of failed
+    records (for the trace join)."""
+    lines.append(f"reason: {payload['reason']}")
+    if payload.get("context"):
+        ctx = ", ".join(f"{k}={v}" for k, v in payload["context"].items())
+        lines.append(f"context: {ctx}")
+    records = payload["records"]
+    lines.append(f"records: {len(records)} retained "
+                 f"(of {payload.get('n_recorded', len(records))} recorded, "
+                 f"capacity {payload.get('capacity', '?')})")
+    failed_keys = []
+    for rec in records:
+        kind = rec.get("kind", "?")
+        flag = " <<<" if (kind in _FAIL_KINDS or "error" in rec) else ""
+        body = ", ".join(f"{k}={v}" for k, v in rec.items()
+                         if k not in ("ts", "kind"))
+        lines.append(f"  [{kind}] {body}{flag}")
+        if flag and rec.get("key"):
+            failed_keys.append(rec["key"])
+    return failed_keys
+
+
+def render_trace(events: list, lines: list, join_keys=()) -> None:
+    spans = [e for e in events if e.get("ph") == "X"]
+    agg = defaultdict(lambda: [0, 0.0, 0.0])        # name -> n, total, max
+    per_key = defaultdict(lambda: defaultdict(float))
+    for e in spans:
+        a = agg[e["name"]]
+        a[0] += 1
+        a[1] += e.get("dur", 0.0)
+        a[2] = max(a[2], e.get("dur", 0.0))
+        key = (e.get("args") or {}).get("key") or (e.get("args") or {}).get("file")
+        if key in join_keys:
+            per_key[key][e["name"]] += e.get("dur", 0.0)
+    lines.append(f"{len(spans)} spans, {len(agg)} span names "
+                 f"({len(events)} events total)")
+    lines.append(f"  {'span':<16}{'n':>6}{'total_ms':>12}"
+                 f"{'mean_ms':>10}{'max_ms':>10}")
+    for name, (n, total, mx) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+        lines.append(f"  {name:<16}{n:>6}{_fmt_ms(total):>12}"
+                     f"{_fmt_ms(total / n):>10}{_fmt_ms(mx):>10}")
+    for key, stages in per_key.items():
+        stage_s = ", ".join(f"{k}={_fmt_ms(v)}ms"
+                            for k, v in sorted(stages.items()))
+        lines.append(f"  failed-record join {key}: {stage_s}")
+
+
+def render_metrics(snaps: list, lines: list) -> None:
+    last = snaps[-1]
+    lines.append(f"{len(snaps)} snapshot lines; last at ts={last['ts']:.3f}")
+    for name, fam in sorted(last["metrics"].items()):
+        for lbl, val in sorted(fam.get("values", {}).items()):
+            where = "" if lbl == "()" else lbl
+            if isinstance(val, dict):               # histogram
+                lines.append(
+                    f"  {name}{where}: n={val.get('n')} p50={val.get('p50'):g}"
+                    f" p95={val.get('p95'):g} p99={val.get('p99'):g}"
+                    f" max={val.get('max'):g} count={val.get('count')}")
+            else:
+                lines.append(f"  {name}{where}: {val:g}")
+
+
+def build_report(flight_paths, trace_path, metrics_path) -> str:
+    lines: list = ["# das_diff_veh_tpu observability report"]
+    join_keys: list = []
+    for path in flight_paths or ():
+        lines.append("")
+        lines.append(f"## flight dump: {path}")
+        join_keys += render_flight(load_flight_dump(path), lines)
+    if trace_path:
+        lines.append("")
+        lines.append(f"## trace: {trace_path}")
+        render_trace(load_trace(trace_path), lines, join_keys=set(join_keys))
+    if metrics_path:
+        lines.append("")
+        lines.append(f"## metrics: {metrics_path}")
+        snaps = load_metrics_jsonl(metrics_path)
+        if snaps:
+            render_metrics(snaps, lines)
+        else:
+            lines.append("(empty metrics file)")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--flight", nargs="*", default=[], metavar="JSON",
+                   help="flight-recorder dump artifact(s)")
+    p.add_argument("--trace", default=None, metavar="JSONL",
+                   help="Chrome-trace span file (runtime/serve tracer)")
+    p.add_argument("--metrics", default=None, metavar="JSONL",
+                   help="metrics-sink snapshot file")
+    p.add_argument("--out", default=None,
+                   help="write the report here instead of stdout")
+    args = p.parse_args(argv)
+    if not (args.flight or args.trace or args.metrics):
+        p.error("give at least one of --flight/--trace/--metrics")
+    try:
+        report = build_report(args.flight, args.trace, args.metrics)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"obs_report: failed to parse artifacts: {e}", file=sys.stderr)
+        return 2
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(report)
+    else:
+        sys.stdout.write(report)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,514 @@
+"""Observability layer tests: registry, exposition, sink, flight, hooks.
+
+Everything is stub-driven — no ``process_chunk`` traces (tier-1 budget).
+The one jit in this module is a scalar lambda (millisecond compile) used to
+prove the ``jax.monitoring`` counters see real lowerings.  The end-to-end
+path (batch run -> trace + metrics JSONL + forced flight dump ->
+``scripts/obs_report.py``) reuses test_runtime's cheap ``compute_fn``
+pattern.
+"""
+
+import json
+import os
+import re
+import signal
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+from das_diff_veh_tpu.config import ObsConfig
+from das_diff_veh_tpu.core.section import DasSection
+from das_diff_veh_tpu.io.readers import DirectoryDataset, save_section_npz
+from das_diff_veh_tpu.obs import (FlightRecorder, HBMSampler, MetricsRegistry,
+                                  MetricsSink, ProfilerWindow, load_flight_dump,
+                                  load_metrics_jsonl, register_memory_gauges,
+                                  xla_events)
+from das_diff_veh_tpu.pipeline.workflow import run_directory
+from das_diff_veh_tpu.runtime import (ChunkTask, RuntimeConfig, TraceWriter,
+                                      load_trace, run_pipelined)
+
+DATE = "20230301"
+
+
+# --------------------------------------------------------------------------
+# registry
+# --------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("das_t_total", "things", labels=("kind",))
+    c.labels(kind="a").inc()
+    c.labels(kind="a").inc(2)
+    c.labels(kind="b").inc(5)
+    assert c.labels(kind="a").value == 3
+    assert c.labels(kind="b").value == 5
+    with pytest.raises(ValueError, match=">= 0"):
+        c.labels(kind="a").inc(-1)
+
+    g = reg.gauge("das_depth")
+    g.set(4)
+    assert g.value == 4
+    g.set_fn(lambda: 9)
+    assert g.value == 9
+    g.set_fn(lambda: 1 / 0)            # a dead provider must not kill reads
+    assert g.value == 9                # last good value
+
+    h = reg.histogram("das_lat_ms", window=4)
+    for v in (1.0, 2.0, 3.0, 4.0, 5.0):
+        h.observe(v)
+    assert h.count == 5 and h.sum == 15.0      # monotonic despite the ring
+    assert h.values() == [2.0, 3.0, 4.0, 5.0]  # bounded window
+    p = h.percentiles()
+    assert p["p50"] == 4.0 and p["n"] == 4 and p["max"] == 5.0
+
+
+def test_registry_reregistration_and_mismatch():
+    reg = MetricsRegistry()
+    a = reg.counter("das_x_total", labels=("k",))
+    assert reg.counter("das_x_total", labels=("k",)) is a   # idempotent
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("das_x_total", labels=("k",))
+    with pytest.raises(ValueError, match="already registered"):
+        reg.counter("das_x_total", labels=("other",))
+    with pytest.raises(ValueError, match="invalid metric name"):
+        reg.counter("bad name!")
+    with pytest.raises(ValueError, match="invalid label"):
+        reg.counter("das_ok_total", labels=("bad-label",))
+    with pytest.raises(ValueError, match="expected labels"):
+        a.labels(wrong="x")
+
+
+# one exposition-format checker shared with the serve HTTP test
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*="
+    r"\"(?:[^\"\\\n]|\\.)*\"(?:,[a-zA-Z_][a-zA-Z0-9_]*="
+    r"\"(?:[^\"\\\n]|\\.)*\")*\})? -?[0-9.e+-]+(?:[0-9]|inf|nan)?$")
+
+
+def assert_prometheus_wellformed(text: str) -> dict:
+    """Validate exposition lines; returns {metric_name: type}."""
+    types = {}
+    for line in text.rstrip("\n").split("\n"):
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, ptype = line.split(" ", 3)
+            assert ptype in ("counter", "gauge", "summary"), line
+            types[name] = ptype
+        else:
+            assert _SAMPLE_RE.match(line), f"malformed sample line: {line!r}"
+            base = line.split("{")[0].split(" ")[0]
+            stripped = re.sub(r"_(sum|count)$", "", base)
+            assert base in types or stripped in types, \
+                f"sample without TYPE: {line!r}"
+    return types
+
+
+def test_prometheus_exposition_wellformed_and_escaped():
+    reg = MetricsRegistry()
+    reg.counter("das_e_total", "events", labels=("name",)).labels(
+        name='we"ird\\path\nx').inc()
+    reg.gauge("das_g", "a gauge").set(-2.5)
+    h = reg.histogram("das_h_ms", "ring")
+    h.observe(1.5)
+    types = assert_prometheus_wellformed(reg.prometheus_text())
+    assert types == {"das_e_total": "counter", "das_g": "gauge",
+                     "das_h_ms": "summary"}
+    text = reg.prometheus_text()
+    assert 'name="we\\"ird\\\\path\\nx"' in text
+    assert 'das_h_ms{quantile="0.99"} 1.5' in text
+
+
+def test_registry_to_json_shape():
+    reg = MetricsRegistry()
+    reg.counter("das_a_total").inc(3)
+    reg.histogram("das_b_ms").observe(2.0)
+    j = reg.to_json()
+    assert j["das_a_total"] == {"kind": "counter", "values": {"()": 3.0}}
+    hb = j["das_b_ms"]["values"]["()"]
+    assert hb["count"] == 1 and hb["p50"] == 2.0
+
+
+# --------------------------------------------------------------------------
+# JSONL sink
+# --------------------------------------------------------------------------
+
+def test_metrics_sink_writes_parseable_lines(tmp_path):
+    reg = MetricsRegistry()
+    c = reg.counter("das_n_total")
+    path = str(tmp_path / "metrics.jsonl")
+    sink = MetricsSink(reg, path, interval_s=60.0)   # ticks won't fire; we do
+    c.inc()
+    sink.flush()
+    c.inc()
+    sink.close()                                      # final snapshot line
+    snaps = load_metrics_jsonl(path)
+    assert len(snaps) == 2
+    assert snaps[0]["metrics"]["das_n_total"]["values"]["()"] == 1.0
+    assert snaps[-1]["metrics"]["das_n_total"]["values"]["()"] == 2.0
+    assert snaps[0]["ts"] <= snaps[-1]["ts"]
+    sink.close()                                      # idempotent
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"no": "keys"}\n')
+    with pytest.raises(ValueError, match="missing ts/metrics"):
+        load_metrics_jsonl(str(bad))
+
+
+def test_metrics_sink_appends_across_runs_and_creates_parent(tmp_path):
+    # run_date_range builds one sink per date against the same path: the
+    # second open must append, not truncate the first date's snapshots
+    path = str(tmp_path / "deep" / "dir" / "metrics.jsonl")   # parent made
+    for run in range(2):
+        reg = MetricsRegistry()
+        reg.counter("das_run_total").inc(run + 1)
+        sink = MetricsSink(reg, path, interval_s=60.0)
+        sink.close()
+    snaps = load_metrics_jsonl(path)
+    assert len(snaps) == 2
+    assert snaps[0]["metrics"]["das_run_total"]["values"]["()"] == 1.0
+    assert snaps[1]["metrics"]["das_run_total"]["values"]["()"] == 2.0
+
+
+# --------------------------------------------------------------------------
+# trace writer flush batching (satellite: no syscall per span by choice)
+# --------------------------------------------------------------------------
+
+def test_trace_writer_default_flushes_per_event(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    w = TraceWriter(path)
+    with w.span("s1"):
+        pass
+    # durability: the span is on disk BEFORE close (crash-safe default)
+    assert any(json.loads(ln)["name"] == "s1"
+               for ln in open(path) if ln.strip())
+    w.close()
+
+
+def test_trace_writer_batched_flush_defers_then_close_flushes(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    w = TraceWriter(path, flush_interval_s=3600.0)
+    for i in range(50):
+        with w.span(f"s{i}"):
+            pass
+    # nothing (beyond at most the first buffer fill) should have hit disk
+    assert os.path.getsize(path) == 0
+    w.flush()
+    assert os.path.getsize(path) > 0
+    with w.span("tail"):
+        pass
+    w.close()                      # close always flushes the tail
+    events = load_trace(path)      # every line valid Chrome-trace
+    names = {e["name"] for e in events}
+    assert "s0" in names and "s49" in names and "tail" in names
+
+
+# --------------------------------------------------------------------------
+# flight recorder
+# --------------------------------------------------------------------------
+
+def test_flight_ring_bound_and_dump_schema(tmp_path):
+    fr = FlightRecorder(capacity=4, out_dir=str(tmp_path), name="f")
+    for i in range(10):
+        fr.record("chunk", key=f"k{i}")
+    path = fr.dump("quarantine", key="k9")
+    payload = load_flight_dump(path)
+    assert payload["reason"] == "quarantine"
+    assert payload["context"] == {"key": "k9"}
+    assert payload["n_recorded"] == 10
+    keys = [r["key"] for r in payload["records"]]
+    assert keys == ["k6", "k7", "k8", "k9"]        # last capacity records
+    # rate limit: a second dump for the same reason inside the window is
+    # suppressed; force overrides; another reason is its own window
+    assert fr.dump("quarantine") is None
+    assert fr.dump("quarantine", force=True) is not None
+    assert fr.dump("shed") is not None
+    assert fr.n_dumps == 3
+
+
+def test_flight_dump_names_unique_across_recorder_instances(tmp_path):
+    # bench A/B reps (and a re-run date) build fresh recorders with the
+    # same name in one process; dump filenames must never collide
+    paths = []
+    for rep in range(2):
+        fr = FlightRecorder(capacity=2, out_dir=str(tmp_path), name="same")
+        fr.record("chunk", rep=rep)
+        paths.append(fr.dump("quarantine", force=True))
+    assert paths[0] != paths[1]
+    assert load_flight_dump(paths[0])["records"][0]["rep"] == 0
+    assert load_flight_dump(paths[1])["records"][0]["rep"] == 1
+
+
+def test_flight_without_out_dir_records_but_never_writes(tmp_path):
+    fr = FlightRecorder(capacity=2)
+    fr.record("request", shape=[4, 16])
+    assert fr.dump("error") is None
+    assert len(fr.records()) == 1
+    # explicit path still dumps (obs_report tooling, tests)
+    p = str(tmp_path / "explicit.json")
+    assert fr.dump("error", path=p) == p
+    assert load_flight_dump(p)["records"][0]["shape"] == [4, 16]
+
+
+def test_flight_signal_handler_dumps_and_chains(tmp_path):
+    fr = FlightRecorder(capacity=8, out_dir=str(tmp_path), name="sig")
+    fr.record("chunk", key="k0")
+    seen = []
+    prev = signal.signal(signal.SIGUSR1, lambda s, f: seen.append(s))
+    try:
+        assert fr.install_signal_handlers(signals=(signal.SIGUSR1,))
+        signal.raise_signal(signal.SIGUSR1)
+        dumps = [f for f in os.listdir(tmp_path) if f.startswith("sig_sig")]
+        assert len(dumps) == 1                     # dumped on the signal
+        assert seen == [signal.SIGUSR1]            # chained to previous
+        fr.uninstall_signal_handlers()
+        signal.raise_signal(signal.SIGUSR1)
+        assert seen == [signal.SIGUSR1] * 2        # fully restored
+    finally:
+        signal.signal(signal.SIGUSR1, prev)
+
+
+# --------------------------------------------------------------------------
+# jax.monitoring hooks
+# --------------------------------------------------------------------------
+
+def test_xla_event_counters_see_fresh_compiles_and_stay_flat_cached():
+    import jax
+    import jax.numpy as jnp
+
+    reg = MetricsRegistry()
+    watch = xla_events.install(reg)
+    try:
+        assert watch.traces == 0                   # families exist at zero
+        f = jax.jit(lambda x: x * 2.0 + 1.0)
+        f(jnp.float32(3.0)).block_until_ready()
+        after_compile = watch.traces
+        assert after_compile >= 1                  # fresh lowering counted
+        for _ in range(3):
+            f(jnp.float32(4.0)).block_until_ready()
+        assert watch.traces == after_compile       # cache hits: no events
+    finally:
+        xla_events.uninstall(reg)
+    f2 = jax.jit(lambda x: x * 5.0 - 2.0)
+    f2(jnp.float32(1.0)).block_until_ready()
+    assert watch.traces == after_compile           # unsubscribed: flat
+
+
+def test_xla_event_subscriptions_are_refcounted():
+    """Two components sharing one registry (the serve CLI's engine + an
+    in-process batch run both install the process default): the first
+    component's uninstall must not freeze the other's counters."""
+    import jax
+    import jax.numpy as jnp
+
+    reg = MetricsRegistry()
+    watch = xla_events.install(reg)            # component A (serve engine)
+    xla_events.install(reg)                    # component B (a batch run)
+    xla_events.uninstall(reg)                  # B finishes first
+    try:
+        jax.jit(lambda x: x * 3.0 + 9.0)(jnp.float32(1.0)).block_until_ready()
+        assert watch.traces >= 1               # A still counting
+    finally:
+        xla_events.uninstall(reg)              # A releases the last ref
+    n = watch.traces
+    jax.jit(lambda x: x / 3.0 - 4.0)(jnp.float32(1.0)).block_until_ready()
+    assert watch.traces == n                   # fully unsubscribed now
+
+
+def test_xla_event_install_is_idempotent():
+    reg = MetricsRegistry()
+    xla_events.install(reg)
+    xla_events.install(reg)
+    try:
+        import jax
+        import jax.numpy as jnp
+        jax.jit(lambda x: x - 7.0)(jnp.float32(2.0)).block_until_ready()
+        fam = reg.get("das_jax_traces_total")
+        n = fam.value
+        assert n >= 1
+        # double-install must not double-count
+        assert n == xla_events.CompileWatch(reg).traces
+    finally:
+        xla_events.uninstall(reg)
+        xla_events.uninstall(reg)                  # idempotent
+
+
+# --------------------------------------------------------------------------
+# profiling hooks
+# --------------------------------------------------------------------------
+
+def test_profiler_window_captures_steady_state_steps(tmp_path):
+    import jax.numpy as jnp
+
+    reg = MetricsRegistry()
+    win = ProfilerWindow(str(tmp_path / "prof"), start_after=2, n_steps=1,
+                         registry=reg)
+    for _ in range(4):
+        (jnp.ones(8) * 2).block_until_ready()
+        win.step()
+    win.close()
+    assert win.captured
+    assert reg.gauge("das_obs_profiled_steps").value == 1
+    # the capture landed on disk (plugins/... structure is backend-specific)
+    captured = [os.path.join(dp, f)
+                for dp, _, fs in os.walk(tmp_path / "prof") for f in fs]
+    assert captured, "profiler window produced no artifact"
+
+
+def test_memory_gauges_and_sampler_degrade_gracefully_on_cpu():
+    reg = MetricsRegistry()
+    register_memory_gauges(reg)                    # CPU: memory_stats None
+    assert reg.get("das_device_bytes_in_use") is not None
+    assert reg.get("das_device_peak_bytes") is not None
+    reg.prometheus_text()                          # scrape never raises
+    s = HBMSampler(reg, interval_s=0.01)
+    time.sleep(0.05)
+    s.close()
+
+
+# --------------------------------------------------------------------------
+# executor + workflow wiring (stub compute — no process_chunk)
+# --------------------------------------------------------------------------
+
+def test_run_pipelined_registers_metrics_and_dumps_on_quarantine(tmp_path):
+    reg = MetricsRegistry()
+    fr = FlightRecorder(capacity=16, out_dir=str(tmp_path), name="rt")
+    tasks = [ChunkTask(i, f"t{i}", (lambda i=i: i)) for i in range(5)]
+
+    def compute(v):
+        if v == 3:
+            raise ValueError("poisoned chunk")
+        return v
+
+    got = []
+    stats = run_pipelined(tasks, compute, lambda t, r: got.append(r),
+                          cfg=RuntimeConfig(max_retries=1,
+                                            retry_backoff_s=0.0),
+                          registry=reg, flight=fr)
+    assert stats.n_done == 4 and len(stats.quarantined) == 1
+    chunks = reg.counter("das_runtime_chunks_total", labels=("status",))
+    assert chunks.labels(status="done").value == 4
+    assert chunks.labels(status="quarantined").value == 1
+    retries = reg.counter("das_runtime_retries_total", labels=("stage",))
+    assert retries.labels(stage="compute").value == 1
+    assert reg.histogram("das_runtime_chunk_seconds").count == 4
+    assert reg.get("das_runtime_prefetch_depth") is not None
+    dumps = [f for f in os.listdir(tmp_path) if f.startswith("rt_quarantine")]
+    assert len(dumps) == 1
+    payload = load_flight_dump(os.path.join(tmp_path, dumps[0]))
+    assert payload["context"] == {"key": "t3", "stage": "compute"}
+    failed = [r for r in payload["records"] if r.get("error")]
+    assert failed and failed[0]["key"] == "t3"
+    assert "poisoned chunk" in failed[0]["error"]
+
+
+def test_obs_disabled_is_genuinely_off(tmp_path):
+    """``ObsConfig.enabled=False`` (the bench A/B's bare side): no registry
+    counting, no flight artifacts — even with a flight_dir configured."""
+    from das_diff_veh_tpu.obs import default_registry
+
+    reg = default_registry()
+    fam = reg.get("das_runtime_chunks_total")
+    before = fam.labels(status="done").value if fam is not None else 0.0
+    tasks = [ChunkTask(i, f"t{i}", (lambda i=i: i)) for i in range(3)]
+    off = ObsConfig(enabled=False, flight_dir=str(tmp_path))
+    stats = run_pipelined(tasks, lambda v: v, lambda t, r: None,
+                          cfg=RuntimeConfig(max_retries=0, obs=off))
+    assert stats.n_done == 3
+    fam = reg.get("das_runtime_chunks_total")
+    after = fam.labels(status="done").value if fam is not None else 0.0
+    assert after == before                     # nothing counted anywhere
+    assert os.listdir(tmp_path) == []          # and nothing written
+
+
+def _write_dir(root, n_files, corrupt=()):
+    day = os.path.join(str(root), DATE)
+    os.makedirs(day, exist_ok=True)
+    rng = np.random.default_rng(3)
+    for i in range(n_files):
+        path = os.path.join(day, f"{DATE}_{i:02d}0000.npz")
+        if i in corrupt:
+            with open(path, "wb") as f:
+                f.write(b"not an npz")
+        else:
+            sec = DasSection(rng.standard_normal((6, 128)),
+                             np.arange(6.0), np.arange(128) / 250.0)
+            save_section_npz(path, sec)
+    return str(root)
+
+
+def _fake_compute(section):
+    d = np.asarray(section.data)
+    return 1, np.outer(d.mean(axis=1)[:3], d.std(axis=1)[:3] + 1.0)
+
+
+def test_run_directory_obs_disabled(tmp_path):
+    """The workflow's disabled path: all obs handles None, result intact."""
+    root = _write_dir(tmp_path / "data", 2)
+    res = run_directory(
+        DirectoryDataset(DATE, root=root, ch1=None, ch2=None,
+                         smoothing=False, rescale_after=None),
+        compute_fn=_fake_compute,
+        runtime=RuntimeConfig(max_retries=0,
+                              obs=ObsConfig(enabled=False)))
+    assert res.n_chunks == 2 and not res.quarantined
+
+
+def test_run_directory_emits_all_obs_artifacts_and_report_renders(tmp_path):
+    """The end-to-end observability path the verify recipe exercises: one
+    batch run (stub compute, one corrupt file) leaves a trace, a metrics
+    JSONL, and a quarantine flight dump, and ``scripts/obs_report.py``
+    joins all three into a report."""
+    root = _write_dir(tmp_path / "data", 4, corrupt=(2,))
+    obs_dir = tmp_path / "obs"
+    obs_dir.mkdir()
+    trace = str(obs_dir / "trace.jsonl")
+    metrics = str(obs_dir / "metrics.jsonl")
+    runtime = RuntimeConfig(
+        prefetch_depth=2, max_retries=0, trace_path=trace,
+        obs=ObsConfig(metrics_jsonl=metrics, metrics_interval_s=30.0,
+                      flight_dir=str(obs_dir), trace_flush_interval_s=0.05,
+                      hbm_sample_interval_s=0.02))   # sampler wired + closed
+    res = run_directory(
+        DirectoryDataset(DATE, root=root, ch1=None, ch2=None,
+                         smoothing=False, rescale_after=None),
+        compute_fn=_fake_compute, runtime=runtime)
+    assert res.n_chunks == 3 and len(res.quarantined) == 1
+
+    load_trace(trace)                              # valid despite batching
+    snaps = load_metrics_jsonl(metrics)            # final line always written
+    assert snaps
+    last = snaps[-1]["metrics"]
+    done = last["das_runtime_chunks_total"]["values"]['{status="done"}']
+    assert done >= 3                               # global registry: >=
+    dumps = [str(obs_dir / f) for f in os.listdir(obs_dir)
+             if f.startswith(f"flight_{DATE}_quarantine")]
+    assert len(dumps) == 1
+    payload = load_flight_dump(dumps[0])
+    kinds = {r["kind"] for r in payload["records"]}
+    assert "run" in kinds and "chunk" in kinds     # config hash + chunks
+
+    import obs_report
+    out = str(obs_dir / "report.txt")
+    rc = obs_report.main(["--flight", dumps[0], "--trace", trace,
+                          "--metrics", metrics, "--out", out])
+    assert rc == 0
+    report = open(out).read()
+    assert "## flight dump" in report and "## trace" in report \
+        and "## metrics" in report
+    assert "quarantine" in report
+    assert "das_runtime_chunks_total" in report
+    assert re.search(r"failed-record join .*\.npz", report)
+
+
+def test_obs_report_rejects_malformed_artifacts(tmp_path, capsys):
+    import obs_report
+    bad = tmp_path / "bad.json"
+    bad.write_text("{}")
+    assert obs_report.main(["--flight", str(bad)]) == 2
+    assert "failed to parse" in capsys.readouterr().err

@@ -310,6 +310,13 @@ def test_metrics_snapshot_counters_and_percentiles():
         assert lat["n"] == 5
         assert 0 <= lat["p50"] <= lat["p95"] <= lat["p99"] <= lat["max"]
         assert set(m["stages_ms"]) >= {"queue", "pad", "compute", "unpad"}
+        # stages report the same percentile set as totals (they used to
+        # report only means), from the same shared-histogram rings
+        for stage in ("queue", "pad", "compute", "unpad"):
+            s = m["stages_ms"][stage]
+            assert s["n"] == 5
+            assert 0 <= s["p50"] <= s["p95"] <= s["p99"]
+            assert s["mean"] >= 0
         assert m["buckets"] == [[8, 32], [16, 64]]
     finally:
         eng.close()
@@ -388,6 +395,78 @@ def test_http_smoke():
         with urllib.request.urlopen(base + "/v1/metrics", timeout=15) as r:
             m = json.loads(r.read())
         assert m["completed"] == 1 and m["shed_no_bucket"] == 1
+    finally:
+        server.shutdown()
+        server.server_close()
+        eng.close()
+
+
+def test_http_prometheus_scrape_one_registry_zero_compiles():
+    """The serve HTTP metrics surface (ISSUE 6 satellite): ``GET /metrics``
+    is well-formed Prometheus text exposition, ``/v1/metrics`` keeps its
+    legacy JSON shape, the ``jax.monitoring`` compile counter stays 0
+    across a warmed steady-state stub run, and — the one-registry
+    contract — serve, runtime, and parallel families all land in one
+    scrape when the subsystems share a registry (as the serve CLI wires
+    via ``obs.default_registry()``).  All stub-driven: zero fresh
+    ``process_chunk`` compiles."""
+    import jax
+    from jax.sharding import Mesh
+
+    from das_diff_veh_tpu.config import RingConfig
+    from das_diff_veh_tpu.obs import MetricsRegistry
+    from das_diff_veh_tpu.parallel.allpairs import _observe_ring_build
+    from das_diff_veh_tpu.runtime import ChunkTask, RuntimeConfig, run_pipelined
+    from test_obs import assert_prometheus_wellformed
+
+    reg = MetricsRegistry()
+    eng = ServingEngine(FnComputeFactory(_sum_build, "test"),
+                        ServeConfig(buckets=((8, 32),)), registry=reg).start()
+    server, _ = serve_in_thread(eng)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        for _ in range(3):                       # warmed steady-state traffic
+            eng.process(_section(5, 20), timeout=30)
+        deadline = time.perf_counter() + 10.0
+        while eng.metrics()["completed"] < 3:    # set_result precedes the
+            assert time.perf_counter() < deadline
+            time.sleep(0.005)                    # counter increment
+        # runtime + parallel register into the SAME registry
+        run_pipelined([ChunkTask(0, "k0", lambda: 1.0)], lambda v: v,
+                      lambda t, r: None, cfg=RuntimeConfig(max_retries=0),
+                      registry=reg)
+        _observe_ring_build(Mesh(np.array(jax.devices()[:1]), ("ch",)),
+                            RingConfig(), reg)
+
+        with urllib.request.urlopen(base + "/metrics", timeout=15) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        types = assert_prometheus_wellformed(text)
+        assert types["das_serve_events_total"] == "counter"
+        assert types["das_serve_latency_ms"] == "summary"
+        assert types["das_serve_stage_ms"] == "summary"
+        assert types["das_serve_queue_depth"] == "gauge"
+        assert types["das_runtime_chunks_total"] == "counter"  # one scrape
+        assert types["das_ring_builds_total"] == "counter"     # carries all
+        assert types["das_device_bytes_in_use"] == "gauge"     # three layers
+        assert 'das_serve_events_total{event="completed"} 3' in text
+        assert 'das_runtime_chunks_total{status="done"} 1' in text
+        assert 'das_ring_builds_total{mode="ring"} 1' in text
+        # device-truth SLO: zero fresh jit traces since warmup, measured by
+        # the jax.monitoring listener, not the cache's own counters
+        assert "das_jax_traces_total" in types
+        assert "das_serve_steady_state_compiles 0" in text
+
+        # legacy JSON surface unchanged: same keys, same counter values
+        with urllib.request.urlopen(base + "/v1/metrics", timeout=15) as r:
+            m = json.loads(r.read())
+        assert m["completed"] == 3 and m["cache_misses"] == 0
+        assert set(m) >= {"submitted", "completed", "errors", "shed_rejected",
+                          "shed_expired", "shed_no_bucket", "shed_invalid",
+                          "cache_hits", "cache_misses", "warmup_builds",
+                          "queue_depth", "latency_ms", "stages_ms", "batch",
+                          "buckets"}
+        assert set(m["latency_ms"]) == {"n", "p50", "p95", "p99", "max"}
     finally:
         server.shutdown()
         server.server_close()
