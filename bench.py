@@ -32,13 +32,17 @@ entry (``serve_*`` keys) drives an open-loop variable-shape request load
 through naive per-request execution vs the microbatched shape-bucketed
 serving engine (``das_diff_veh_tpu.serve``), reporting p50/p99 latency and
 req/s for both plus the engine's steady-state compile count (asserted 0);
-BENCH_SERVE_REQS/SHAPES/INTERARRIVAL_MS/NCH/NT tune the load.  A
+BENCH_SERVE_REQS/SHAPES/INTERARRIVAL_MS/NCH/NT tune the load.  A chaos
+entry (``chaos_*`` keys) A/Bs fault-free vs 5%-dead-channel degraded-mode
+chunks/s on the e2e directory — the health sentinel masks the injected
+dead channels and the run completes degraded; failures are fault-isolated
+to ``chaos_error`` like the gather entry.  A
 trajectory-gather stage entry (``stage_gather_traj_*`` keys) times the
 fused Pallas scalar-prefetch window cut against the legacy serialized
 vmap(dynamic_slice) formulation at the pipeline's far-side shape
 (BENCH_GATHER_K sets the in-dispatch K, floor 5; off-TPU the fused side
 runs in interpret mode and is labeled parity-evidence-only).  Opt-outs:
-BENCH_SKIP_E2E / BENCH_SKIP_OBS / BENCH_SKIP_SERVE / BENCH_SKIP_PALLAS / BENCH_SKIP_SHARDED /
+BENCH_SKIP_E2E / BENCH_SKIP_OBS / BENCH_SKIP_CHAOS / BENCH_SKIP_SERVE / BENCH_SKIP_PALLAS / BENCH_SKIP_SHARDED /
 BENCH_SKIP_LONG / BENCH_SKIP_10K; BENCH_10K_SRC_CHUNK tunes the 10k
 source-chunk size (default 32 — see docs/PERF.md on the working-set effect).
 
@@ -448,8 +452,72 @@ def main() -> None:
                     float(np.median(instrumented)), 4)
                 extra["obs_overhead_pct"] = round(
                     (off_best - on_best) / off_best * 100.0, 2)
+
+            # chaos/degraded-mode A/B on the SAME directory: fault-free vs
+            # a 5%-dead-channel fleet (injected via the resilience fault
+            # registry, masked+imputed by the health sentinel) — the
+            # throughput cost of running degraded, as a measured ratio.
+            # Fault-isolated like the gather entry: an injection/sentinel
+            # failure surfaces as chaos_error instead of killing the sweep.
+            if not os.environ.get("BENCH_SKIP_CHAOS"):
+                try:
+                    from das_diff_veh_tpu.config import HealthConfig
+                    from das_diff_veh_tpu.resilience import (FaultPlan,
+                                                             FaultSpec,
+                                                             faults)
+
+                    dead_frac = 0.05
+                    pcfg_h = pcfg.replace(health=HealthConfig(enabled=True))
+
+                    def chaos_run() -> tuple:
+                        ds = DirectoryDataset("20230301", root=tdir,
+                                              ch1=None, ch2=None,
+                                              smoothing=True,
+                                              rescale_after=None)
+                        t0 = time.perf_counter()
+                        res = run_directory(
+                            ds, pcfg_h, method="xcorr", x_is_channels=False,
+                            runtime=RuntimeConfig(prefetch_depth=e2e_depth,
+                                                  max_retries=0))
+                        dt = time.perf_counter() - t0
+                        assert res.complete and not res.quarantined
+                        return n_files / dt, res.n_degraded
+
+                    # warm ONLY the sentinel's fused _screen program (the
+                    # single cold piece — process_chunk is already warm from
+                    # the e2e runs above) on one actually-loaded chunk so it
+                    # compiles at the exact post-read shape/dtype; a full
+                    # directory sweep here would re-pay n_files chunks for a
+                    # millisecond compile
+                    from das_diff_veh_tpu.resilience.health import \
+                        screen_section
+                    ds_w = DirectoryDataset("20230301", root=tdir,
+                                            ch1=None, ch2=None,
+                                            smoothing=True,
+                                            rescale_after=None)
+                    screen_section(ds_w[0], pcfg_h.health, tag="bench_warmup")
+                    clean_cps, n_deg0 = chaos_run()
+                    assert n_deg0 == 0
+                    plan = FaultPlan(specs=(FaultSpec(
+                        "io.corrupt", "dead", param=dead_frac),), seed=13)
+                    with faults.injected(plan):
+                        deg_cps, n_deg = chaos_run()
+                    assert n_deg == n_files, \
+                        f"expected every chunk degraded, got {n_deg}"
+                    extra["chaos_dead_channel_fraction"] = dead_frac
+                    extra["chaos_clean_chunks_per_s"] = round(clean_cps, 4)
+                    extra["chaos_degraded_chunks_per_s"] = round(deg_cps, 4)
+                    extra["chaos_degraded_over_clean"] = round(
+                        deg_cps / clean_cps, 3)
+                except Exception as e:  # noqa: BLE001 — disclosed, never fatal
+                    extra["chaos_error"] = f"{type(e).__name__}: {e}"[:300]
         finally:
             shutil.rmtree(tdir, ignore_errors=True)
+    elif not os.environ.get("BENCH_SKIP_CHAOS"):
+        # the chaos A/B rides the e2e directory: skipping e2e skips it too,
+        # but the verify contract wants chaos_* keys OR a disclosure, never
+        # a silent hole in the JSON
+        extra["chaos_error"] = "skipped: BENCH_SKIP_E2E set (chaos A/B runs on the e2e directory)"
 
     # --- online serving: naive per-request vs microbatched+bucketed engine ----
     # Open-loop load (fixed arrival schedule, latency includes queueing) of

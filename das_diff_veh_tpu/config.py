@@ -264,6 +264,60 @@ class RingConfig:
 
 
 @dataclass(frozen=True)
+class HealthConfig:
+    """Input-health sentinel knobs (``das_diff_veh_tpu.resilience.health``).
+
+    Unlike :class:`ObsConfig` these are NOT pure execution knobs: masking an
+    unhealthy channel changes output values (that is the point — a NaN
+    channel would otherwise poison every FFT it touches), so ``health``
+    lives in :class:`PipelineConfig` and participates in the resume
+    manifest's config hash.  Disabled by default: the sentinel then costs
+    one attribute check and zero extra device dispatches
+    (counter-asserted in tests/test_resilience.py).
+    """
+
+    enabled: bool = False
+    """Master switch.  When True, every chunk/request is screened by ONE
+    fused jitted program (NaN/Inf counts, flatline variance, clipping
+    fraction per channel) and unhealthy channels are masked before the
+    gather/VSG/stack path sees them."""
+
+    flatline_var: float = 0.0
+    """A channel whose peak-to-peak span is <= this is flagged
+    dead/flatline (0.0 catches exactly-constant channels — a dead
+    interrogator output — bit-robustly, which a variance threshold would
+    miss to mean-subtraction roundoff)."""
+
+    clip_limit: float = 0.0
+    """Absolute amplitude at which a sample counts as clipped/saturated.
+    0.0 disables clip detection (npz units vary per deployment; set it to
+    the interrogator's full-scale value)."""
+
+    clip_fraction_max: float = 0.05
+    """A channel with more than this fraction of clipped samples is flagged
+    saturated (only with ``clip_limit`` > 0)."""
+
+    impute: bool = True
+    """Replace masked channels by the sum of their immediate neighbors
+    (the ``ops.qc.impute_traces`` rule, mirroring the reference — note:
+    sum, not average, so an interior imputed channel carries roughly the
+    combined neighbor amplitude) instead of leaving them zero.  Either
+    way the mask-aware normalization downstream never divides by a garbage
+    norm; imputation just keeps the aperture gap-free."""
+
+    max_masked_fraction: float = 0.5
+    """Chunk-level poison verdict: when more than this fraction of channels
+    is masked the chunk is beyond degrading — the batch path quarantines it
+    (``PoisonedChunkError``) and the serve path sheds the request pre-batch
+    (HTTP 422) instead of imaging noise."""
+
+    nan_fraction_max: float = 0.0
+    """Request-level admission bound for serving: a request whose global
+    non-finite sample fraction exceeds this is shed as poison before it
+    can join (and corrupt) a microbatch cohort."""
+
+
+@dataclass(frozen=True)
 class ObsConfig:
     """Observability knobs (``das_diff_veh_tpu.obs``), shared by the batch
     runtime (``RuntimeConfig.obs``) and the serving engine
@@ -387,6 +441,14 @@ class ServeConfig:
     the ``jax.monitoring`` compile counters behind the
     ``das_serve_steady_state_compiles`` gauge (see :class:`ObsConfig`)."""
 
+    health: Optional[HealthConfig] = None
+    """Admission-time input-health screen (:class:`HealthConfig`).  When
+    set and enabled, ``submit`` runs a host-side (numpy, zero-dispatch)
+    screen and sheds poison requests — NaN/Inf bursts, dead-channel
+    floods — as :class:`~das_diff_veh_tpu.serve.engine.PoisonInputError`
+    (HTTP 422) before they can join a microbatch, so one corrupt request
+    never contaminates a cohort.  None disables the screen entirely."""
+
 
 @dataclass(frozen=True)
 class PipelineConfig:
@@ -403,6 +465,7 @@ class PipelineConfig:
     dispersion: DispersionConfig = field(default_factory=DispersionConfig)
     imaging: ImagingConfig = field(default_factory=ImagingConfig)
     bootstrap: BootstrapConfig = field(default_factory=BootstrapConfig)
+    health: HealthConfig = field(default_factory=HealthConfig)
     max_windows: int = 64             # static per-chunk window capacity
 
     def replace(self, **kw) -> "PipelineConfig":

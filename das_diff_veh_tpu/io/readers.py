@@ -18,6 +18,7 @@ import numpy as np
 
 from das_diff_veh_tpu.core.section import DasSection
 from das_diff_veh_tpu.io import segy as _segy
+from das_diff_veh_tpu.resilience import faults
 
 
 def _cut_symmetric_taper(data: np.ndarray, t: np.ndarray):
@@ -34,6 +35,12 @@ def read_npz_section(path: str, ch1: Optional[float] = None, ch2: Optional[float
                      cut_taper: bool = True) -> DasSection:
     """Load one npz file with ``data``/``x_axis``/``t_axis`` keys
     (reference key layout: modules/utils.py:94-113)."""
+    # chaos sites (no-ops unless an injector is installed): a read failure,
+    # a slow read, and post-decode data corruption — keyed by basename so a
+    # retried chunk deterministically refires its planned fault
+    key = os.path.basename(path)
+    faults.fire("io.slow", key)
+    faults.fire("io.read", key)
     with np.load(path) as f:
         data, x, t = f["data"], f["x_axis"], f["t_axis"]
     if ch1 is not None and not np.any(x >= ch1):
@@ -43,6 +50,10 @@ def read_npz_section(path: str, ch1: Optional[float] = None, ch2: Optional[float
     data, x = data[lo:hi], x[lo:hi]
     if cut_taper:
         data, t = _cut_symmetric_taper(data, t)
+    # corruption fires on the post-cut waterfall: planned channel indices
+    # (and fraction draws) refer to the channels the pipeline actually sees,
+    # so a counted injection can never be sliced away by ch1/ch2
+    data = faults.corrupt("io.corrupt", key, data)
     return DasSection(np.asarray(data, dtype=np.float64), np.asarray(x, dtype=np.float64),
                       np.asarray(t, dtype=np.float64))
 

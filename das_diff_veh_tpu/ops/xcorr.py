@@ -247,6 +247,13 @@ def _decide_traj_gather(mode: str | None, nwin: int, wlen: int,
                          f"got {finish!r}")
     if mode in (None, "auto"):
         from das_diff_veh_tpu.ops.pallas_gather import fused_supported
+        from das_diff_veh_tpu.resilience import degrade
+        # degradation-ladder rung 2: once the fused kernel has been demoted
+        # (repeated compute-dispatch failures, see resilience/degrade.py),
+        # "auto" resolves to the battle-tested serialized cut.  Explicit
+        # mode="fused" still forces the kernel — the operator's override.
+        if degrade.demoted(degrade.GATHER_FUSED):
+            return False
         return (jax.default_backend() in ("tpu", "axon")
                 and fused_supported(nwin, wlen, finish))
     if mode == "serialized":

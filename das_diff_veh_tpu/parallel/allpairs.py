@@ -69,6 +69,7 @@ from das_diff_veh_tpu.ops.pallas_xcorr import (_decide_pallas,
                                                _window_spectra,
                                                peak_from_spectra)
 from das_diff_veh_tpu.parallel.distributed import ring_perm
+from das_diff_veh_tpu.resilience import faults
 
 
 @partial(jax.jit, static_argnames=("wlen", "overlap_ratio", "spec"))
@@ -125,6 +126,11 @@ def sharded_all_pairs_peak(data: jnp.ndarray, wlen: int, mesh: Mesh, *,
     if ring.mode not in ("ring", "replicated"):
         raise ValueError(f"RingConfig.mode must be 'ring' or 'replicated', "
                          f"got {ring.mode!r}")
+    # chaos site: a simulated ICI/collective failure on the ring path (the
+    # degradation ladder's resilient_all_pairs_peak catches it and falls
+    # back to the replicated layout; see resilience/degrade.py)
+    if ring.mode == "ring":
+        faults.fire("parallel.ring")
     _observe_ring_build(mesh, ring, registry)
     _resolve_win_block(1, win_block)        # validate before any device work
     _resolve_lagmax_block(1, False, ring.lagmax_block)

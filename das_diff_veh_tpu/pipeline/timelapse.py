@@ -36,6 +36,8 @@ class ChunkResult:
     tracks: VehicleTracks
     batch: WindowBatch               # surface-wave-band windows
     qs_batch: Optional[WindowBatch]  # raw-band windows (with_qs=True only)
+    health: Optional[object] = None  # resilience.health.ChannelHealth when
+                                     # cfg.health.enabled, else None
 
 
 def disp_image_batch(batch: WindowBatch, cfg: PipelineConfig) -> jnp.ndarray:
@@ -95,6 +97,21 @@ def process_chunk(section: DasSection, cfg: Optional[PipelineConfig] = None,
     """
     assert method in {"xcorr", "surface_wave"}
     cfg = cfg if cfg is not None else PipelineConfig()
+
+    # --- input-health sentinel (resilience/health.py) ------------------------
+    # Off by default: this branch costs one attribute check and ZERO extra
+    # device dispatches (counter-asserted in tests/test_resilience.py).  On,
+    # one fused jitted program screens NaN/Inf, flatline, and clipped
+    # channels and masks them before anything downstream can average them.
+    health = None
+    if cfg.health.enabled:
+        from das_diff_veh_tpu.resilience.health import (PoisonedChunkError,
+                                                        screen_section)
+        section, health = screen_section(section, cfg.health,
+                                         tag="process_chunk")
+        if not health.ok(cfg.health):
+            raise PoisonedChunkError(health)
+
     x_dist = (channels_to_distance(section.x, cfg.interrogator)
               if x_is_channels else np.asarray(section.x))
     t = np.asarray(section.t)
@@ -139,4 +156,4 @@ def process_chunk(section: DasSection, cfg: Optional[PipelineConfig] = None,
 
     return ChunkResult(disp_image=img, vsg_stack=vsg_stack,
                        n_windows=n_windows, tracks=tracks,
-                       batch=batch, qs_batch=qs_batch)
+                       batch=batch, qs_batch=qs_batch, health=health)

@@ -68,6 +68,12 @@ class DirectoryResult:
     chunks_per_s: float = 0.0                # processed this run (excl. resumed)
     vehicles_per_s: float = 0.0
     complete: bool = True                    # every file settled (not truncated)
+    n_degraded: int = 0                      # chunks that ran with health-masked channels
+    resumed_quarantined: list = field(default_factory=list)
+    """Keys the manifest already held as quarantined at start — known-bad
+    chunks this run skipped without re-failing them (the restart contract;
+    RuntimeConfig.retry_quarantined=True requeues them instead)."""
+    n_requeued: int = 0                      # quarantine records cleared for retry
 
 
 def _manifest_path(out_dir: str, date: str) -> str:
@@ -146,7 +152,11 @@ def run_directory(dataset: DirectoryDataset, cfg: Optional[PipelineConfig] = Non
     ``process_chunk`` imaging pipeline) for any callable
     ``section -> (n_windows, image | None)`` — the extension point for
     other chunk-level workloads riding the same prefetch / quarantine /
-    resume machinery.
+    resume machinery.  With ``cfg.health.enabled`` the input-health
+    sentinel screens every chunk first (custom compute fns receive the
+    sanitized section; a third ``ChannelHealth`` return element, as the
+    default path produces, is surfaced the same way) and chunks that
+    complete with masked channels are counted/flight-recorded as degraded.
     """
     cfg = cfg if cfg is not None else PipelineConfig()
     runtime = runtime if runtime is not None else RuntimeConfig()
@@ -167,7 +177,7 @@ def run_directory(dataset: DirectoryDataset, cfg: Optional[PipelineConfig] = Non
     # stack off: every handle below stays None and run_pipelined sees the
     # same knob, so the instrumented path is genuinely absent, not no-op'd.
     obs_on = obs_cfg.enabled
-    registry = flight = sink = profiler = hbm = None
+    registry = flight = sink = profiler = hbm = c_degraded = None
     xla_installed = signals_installed = False
 
     # everything below may raise (a sink open against a bad path, disk-full
@@ -192,6 +202,9 @@ def run_directory(dataset: DirectoryDataset, cfg: Optional[PipelineConfig] = Non
                 xla_events.install(registry)
                 xla_installed = True
             register_memory_gauges(registry)
+            c_degraded = registry.counter(
+                "das_health_degraded_chunks_total",
+                "chunks completed with health-masked channels")
             if obs_cfg.hbm_sample_interval_s > 0:
                 hbm = HBMSampler(registry,
                                  interval_s=obs_cfg.hbm_sample_interval_s)
@@ -220,19 +233,30 @@ def run_directory(dataset: DirectoryDataset, cfg: Optional[PipelineConfig] = Non
                                        config_hash=chash, date=date)
             # reconcile: the state checkpoint is authoritative for done chunks
             # (quarantine records stay manifest-side; a done entry the state
-            # never absorbed is dropped and recomputed)
+            # never absorbed is dropped and recomputed).  Health provenance
+            # rides along: a resumed degraded chunk keeps its record.
             for k in list(manifest.files):
                 if manifest.files[k]["status"] == "done" and k not in done:
                     del manifest.files[k]
             for k, n in done.items():
-                manifest.mark_done(k, n)
+                prior = manifest.files.get(k) or {}
+                manifest.mark_done(k, n, health=prior.get("health"))
+            # known-bad chunks: skipped on restart (settled), unless the
+            # operator asked for a fresh attempt through the retry ladder
+            if runtime.retry_quarantined:
+                res.n_requeued = manifest.clear_quarantined()
+                if res.n_requeued:
+                    log.info("%s: retry_quarantined — %d known-bad chunks "
+                             "requeued", date, res.n_requeued)
+            res.resumed_quarantined = sorted(manifest.quarantined)
             manifest.complete = False
             manifest.save()
             res.n_resumed = sum(1 for p in dataset.files
                                 if manifest.is_settled(os.path.basename(p)))
             if res.n_resumed:
-                log.info("%s: resuming — %d/%d chunks already settled", date,
-                         res.n_resumed, len(dataset.files))
+                log.info("%s: resuming — %d/%d chunks already settled "
+                         "(%d known-bad skipped)", date, res.n_resumed,
+                         len(dataset.files), len(res.resumed_quarantined))
         state = {"n_vehicles": sum(done.values()),
                  "n_chunks": sum(1 for n in done.values() if n > 0)}
 
@@ -284,14 +308,32 @@ def run_directory(dataset: DirectoryDataset, cfg: Optional[PipelineConfig] = Non
                                   x_is_channels=x_is_channels)
             jax.block_until_ready(chunk.disp_image)
             n = int(chunk.n_windows)
-            return n, (np.asarray(chunk.disp_image) if n > 0 else None)
+            return (n, (np.asarray(chunk.disp_image) if n > 0 else None),
+                    chunk.health)
 
         chunk_fn = compute_fn if compute_fn is not None else _default_compute
 
+        # input-health sentinel for CUSTOM compute fns: the default path
+        # screens inside process_chunk (so ChunkResult carries the verdict);
+        # a caller-supplied compute_fn gets the same screen applied here —
+        # either way exactly one screen per chunk, none when disabled.
+        screen_custom = compute_fn is not None and cfg.health.enabled
+
         def compute(section: DasSection):
             tic = time.perf_counter()
-            n, img = chunk_fn(section)
-            return int(n), img, time.perf_counter() - tic
+            health = None
+            if screen_custom:
+                from das_diff_veh_tpu.resilience.health import (
+                    PoisonedChunkError, screen_section)
+                section, health = screen_section(section, cfg.health,
+                                                 tag="runtime")
+                if not health.ok(cfg.health):
+                    raise PoisonedChunkError(health)
+            out = chunk_fn(section)
+            n, img = out[0], out[1]
+            if len(out) > 2 and out[2] is not None:
+                health = out[2]
+            return int(n), img, time.perf_counter() - tic, health
 
         def checkpoint() -> None:
             if out_dir:
@@ -302,14 +344,28 @@ def run_directory(dataset: DirectoryDataset, cfg: Optional[PipelineConfig] = Non
 
         def accumulate(task: ChunkTask, result) -> None:
             nonlocal acc
-            n, img, dt_chunk = result
+            n, img, dt_chunk, health = result
             if n > 0:
                 acc = img if acc is None else acc + img
                 state["n_vehicles"] += n
                 state["n_chunks"] += 1
+            degraded = health is not None and health.degraded
+            if degraded:
+                # degradation-ladder rung 0: the chunk completed with
+                # unhealthy channels masked — count it, flight-record it,
+                # persist the provenance in the manifest
+                res.n_degraded += 1
+                if c_degraded is not None:
+                    c_degraded.inc()
+                if flight is not None:
+                    flight.record("health", key=task.key, **health.summary())
+                log.warning("chunk %s: degraded — %s", task.key,
+                            health.summary())
             done[task.key] = n
             if manifest is not None:
-                manifest.mark_done(task.key, n)
+                manifest.mark_done(task.key, n,
+                                   health=health.summary() if degraded
+                                   else None)
             seq_done["n"] += 1
             log.info("chunk %s (%d/%d): %d windows, %.2fs", task.key,
                      task.index + 1, len(dataset.files), n, dt_chunk)
@@ -331,10 +387,32 @@ def run_directory(dataset: DirectoryDataset, cfg: Optional[PipelineConfig] = Non
                                           rec.retries)
             checkpoint()
 
+        # degradation-ladder rung 2: a compute-dispatch failure (when the
+        # fused Pallas gather could actually be in play — the DEFAULT
+        # process_chunk path in "auto" mode on a TPU backend; a custom
+        # compute_fn's failure says nothing about the gather) demotes it
+        # process-wide BEFORE the retry, so the retry and every later
+        # chunk trace the serialized fallback.  Poison verdicts are input
+        # problems, not code-path problems, and never demote anything.
+        from das_diff_veh_tpu.resilience import degrade as _degrade
+        from das_diff_veh_tpu.resilience.health import PoisonedChunkError
+
+        def on_stage_failure(stage, key, error, attempt):
+            if stage != "compute" or compute_fn is not None \
+                    or isinstance(error, PoisonedChunkError):
+                return
+            if cfg.gather.traj_gather in (None, "auto") and \
+                    jax.default_backend() in ("tpu", "axon"):
+                lad = _degrade.ladder()
+                if flight is not None and lad.flight is None:
+                    lad.flight = flight
+                lad.note_failure(_degrade.GATHER_FUSED, error)
+
         n_veh0 = state["n_vehicles"]
         stats = run_pipelined(tasks, compute, accumulate, cfg=runtime,
                               tracer=tracer, on_quarantine=on_quarantine,
-                              registry=registry, flight=flight)
+                              registry=registry, flight=flight,
+                              on_stage_failure=on_stage_failure)
 
         # --- completion + result ---------------------------------------------
         res.avg_image = acc
@@ -435,6 +513,7 @@ def run_date_range(root: str, start_date: str, end_date: str,
                              "wall_s": round(res.wall_s, 2),
                              "chunks_per_s": round(res.chunks_per_s, 3),
                              "n_quarantined": len(res.quarantined),
+                             "n_degraded": res.n_degraded,
                              "n_resumed": res.n_resumed,
                              "complete": res.complete}
             log.info("%s: %s", date, json.dumps(summary[date]))

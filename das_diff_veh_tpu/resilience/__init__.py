@@ -1,0 +1,47 @@
+"""Resilience: chaos injection, input-health screening, degradation ladder.
+
+The robustness counterpart of the ``obs`` package — where ``obs`` makes the
+system *observable* under failure, ``resilience`` makes failure *survivable
+and rehearsable*.  Three concerns, one module each:
+
+- :mod:`faults` — deterministic, seeded fault injection behind named sites
+  threaded through the loaders, the batch executor, the serve dispatcher,
+  and the multi-chip ring (off by default, one global read when off);
+- :mod:`health` — a single fused jitted input-health sentinel (NaN/Inf,
+  flatline, clipping per channel) producing the ``ChannelHealth`` mask the
+  gather/VSG/stack path consumes via mask-aware normalization, plus the
+  zero-dispatch numpy screen the serve front sheds poison requests with;
+- :mod:`degrade` — the explicit degradation ladder (mask channels ->
+  serialized gather -> replicated/einsum all-pairs), sticky process-wide
+  demotions with counters and flight events.
+
+Knobs live in ``config.HealthConfig`` (``PipelineConfig.health`` for the
+batch/compute path, ``ServeConfig.health`` for admission); the full model
+— sites, thresholds, ladder rungs, event names — is documented in
+docs/ROBUSTNESS.md.
+"""
+
+from das_diff_veh_tpu.config import HealthConfig
+from das_diff_veh_tpu.resilience.degrade import (DegradationLadder,
+                                                 demoted, ladder,
+                                                 note_failure,
+                                                 resilient_all_pairs_peak,
+                                                 set_ladder)
+from das_diff_veh_tpu.resilience.faults import (FaultInjector, FaultPlan,
+                                                FaultSpec, InjectedFault,
+                                                injected, install, uninstall)
+from das_diff_veh_tpu.resilience.health import (ChannelHealth,
+                                                PoisonedChunkError,
+                                                admission_verdict,
+                                                quick_screen, screen_arrays,
+                                                screen_section)
+
+__all__ = [
+    "HealthConfig",
+    "FaultPlan", "FaultSpec", "FaultInjector", "InjectedFault",
+    "injected", "install", "uninstall",
+    "ChannelHealth", "PoisonedChunkError", "screen_arrays", "screen_section",
+    "quick_screen", "admission_verdict",
+    "DegradationLadder", "ladder", "set_ladder", "demoted", "note_failure",
+    "resilient_all_pairs_peak",
+]

@@ -1,0 +1,199 @@
+"""Explicit degradation ladder: lose capability, not the run.
+
+The pipeline has three tiers of "fancy" with safe fallbacks underneath,
+but until now the fallbacks were only reachable by editing config.  The
+ladder makes the transitions automatic, observable, and sticky:
+
+1. **unhealthy channels masked** (rung 0, per-chunk) — the health sentinel
+   (:mod:`resilience.health`) zeroes/imputes bad channels and the
+   mask-aware normalization downstream carries on; counted per chunk by
+   the batch workflow (``das_health_degraded_chunks_total``);
+2. **fused gather -> serialized** (component ``"gather.fused"``) — when a
+   chunk's compute dispatch fails repeatedly, the Pallas scalar-prefetch
+   gather is the newest/most-hardware-dependent code on the path;
+   demoting it makes ``GatherConfig.traj_gather="auto"`` resolve to the
+   legacy serialized cut (``ops.xcorr._decide_traj_gather`` consults
+   :func:`demoted`) so the retry — and every later chunk — runs the
+   battle-tested formulation;
+3. **ring -> replicated -> einsum** (component ``"parallel.ring"``) — a
+   failed multi-chip ring dispatch (ICI flake, collective timeout) falls
+   back to the replicated layout, and a replicated Pallas failure falls
+   back once more to the pure-XLA einsum path
+   (:func:`resilient_all_pairs_peak`).
+
+Demotions are **process-wide and sticky** (a flaking kernel should not be
+retried per chunk), recorded as ``das_degrade_transitions_total{component}``
+counters, a ``das_degrade_active{component}`` gauge, and a ``"degrade"``
+flight-recorder event; :func:`reset` restores full capability (tests, or an
+operator after a driver fix).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional
+
+log = logging.getLogger("das_diff_veh_tpu.resilience")
+
+#: ladder components with automatic fallbacks
+GATHER_FUSED = "gather.fused"
+PARALLEL_RING = "parallel.ring"
+
+
+class DegradationLadder:
+    """Failure-count -> sticky demotion registry with obs wiring.
+
+    ``threshold`` failures of a component demote it (default 1: the first
+    failure already cost a retry — flaky hardware earns no benefit of the
+    doubt on the hot path).  ``flight`` is optional; when given every
+    transition lands a ``"degrade"`` record.
+    """
+
+    def __init__(self, registry=None, flight=None, threshold: int = 1):
+        if registry is None:
+            from das_diff_veh_tpu.obs.registry import default_registry
+            registry = default_registry()
+        self._lock = threading.Lock()
+        self._fails: Dict[str, int] = {}
+        self._demoted: Dict[str, str] = {}      # component -> last error
+        self.flight = flight
+        self.threshold = max(int(threshold), 1)
+        self._transitions = registry.counter(
+            "das_degrade_transitions_total",
+            "degradation-ladder demotions, by component",
+            labels=("component",))
+        self._active = registry.gauge(
+            "das_degrade_active",
+            "1 while the component runs demoted, else 0",
+            labels=("component",))
+
+    def demoted(self, component: str) -> bool:
+        with self._lock:
+            return component in self._demoted
+
+    def failures(self, component: str) -> int:
+        with self._lock:
+            return self._fails.get(component, 0)
+
+    def note_failure(self, component: str, error=None) -> bool:
+        """Record one failure; returns True when the component is (now)
+        demoted.  Idempotent past the threshold — counters fire once."""
+        err = f"{type(error).__name__}: {error}" if error is not None else ""
+        with self._lock:
+            self._fails[component] = self._fails.get(component, 0) + 1
+            if component in self._demoted:
+                return True
+            if self._fails[component] < self.threshold:
+                return False
+            self._demoted[component] = err
+        log.warning("degradation ladder: %s demoted after %d failure(s): %s",
+                    component, self.failures(component), err or "(no error)")
+        self._transitions.labels(component=component).inc()
+        self._active.labels(component=component).set(1.0)
+        if self.flight is not None:
+            self.flight.record("degrade", component=component, error=err,
+                               failures=self.failures(component))
+        return True
+
+    def reset(self, component: Optional[str] = None) -> None:
+        with self._lock:
+            comps = [component] if component else list(self._demoted)
+            for c in comps:
+                self._demoted.pop(c, None)
+                self._fails.pop(c, None)
+        for c in comps:
+            self._active.labels(component=c).set(0.0)
+
+
+# --------------------------------------------------------------------------
+# process-wide ladder — consulted by ops.xcorr / parallel.allpairs
+# --------------------------------------------------------------------------
+
+_LADDER: Optional[DegradationLadder] = None
+_LADDER_LOCK = threading.Lock()
+
+
+def ladder() -> DegradationLadder:
+    """The process ladder (lazily built against the default registry)."""
+    global _LADDER
+    with _LADDER_LOCK:
+        if _LADDER is None:
+            _LADDER = DegradationLadder()
+        return _LADDER
+
+
+def set_ladder(lad: Optional[DegradationLadder]) -> None:
+    global _LADDER
+    with _LADDER_LOCK:
+        _LADDER = lad
+
+
+def demoted(component: str) -> bool:
+    """Cheap process-wide consult: False when no ladder was ever built (the
+    common case — one global read, no allocation)."""
+    lad = _LADDER
+    return lad is not None and lad.demoted(component)
+
+
+def note_failure(component: str, error=None) -> bool:
+    return ladder().note_failure(component, error)
+
+
+def reset(component: Optional[str] = None) -> None:
+    lad = _LADDER
+    if lad is not None:
+        lad.reset(component)
+
+
+# --------------------------------------------------------------------------
+# rung 3: the multi-chip all-pairs engine with automatic layout fallback
+# --------------------------------------------------------------------------
+
+def resilient_all_pairs_peak(data, wlen: int, mesh, *,
+                             ring=None, lad: Optional[DegradationLadder] = None,
+                             **kw):
+    """``parallel.allpairs.sharded_all_pairs_peak`` behind the ladder.
+
+    Tries the configured decomposition (ring unless already demoted), falls
+    back to the replicated layout on failure, and to the pure-XLA einsum
+    path (``use_pallas=False``) on a second failure — recording each
+    transition.  Pre-dispatch input-validation errors (``ValueError`` /
+    ``TypeError``, e.g. a bad ``win_block``) re-raise untouched: they are
+    caller bugs every rung would fail identically, not hardware flakes,
+    and must never demote the ring.  Raises only when the last rung fails
+    too (or when there is no lower rung left to stand on).
+    """
+    import dataclasses
+
+    from das_diff_veh_tpu.config import RingConfig
+    from das_diff_veh_tpu.parallel.allpairs import sharded_all_pairs_peak
+
+    lad = lad if lad is not None else ladder()
+    cfg = ring if ring is not None else RingConfig()
+    if cfg.mode == "ring" and lad.demoted(PARALLEL_RING):
+        cfg = dataclasses.replace(cfg, mode="replicated")
+    try:
+        return sharded_all_pairs_peak(data, wlen, mesh, ring=cfg, **kw)
+    except (ValueError, TypeError):   # validation, not dispatch — no rung
+        raise
+    except Exception as e:  # noqa: BLE001 — any dispatch failure degrades
+        if cfg.mode != "ring":
+            # already on the replicated rung: drop the Pallas kernel too —
+            # unless the caller already had it off, in which case the retry
+            # would be the byte-identical call that just failed
+            if kw.get("use_pallas") is False:
+                raise
+            lad.note_failure(PARALLEL_RING, e)
+            kw = dict(kw, use_pallas=False)
+            return sharded_all_pairs_peak(data, wlen, mesh, ring=cfg, **kw)
+        lad.note_failure(PARALLEL_RING, e)
+        cfg = dataclasses.replace(cfg, mode="replicated")
+        try:
+            return sharded_all_pairs_peak(data, wlen, mesh, ring=cfg, **kw)
+        except Exception as e2:  # noqa: BLE001
+            lad.note_failure(PARALLEL_RING, e2)
+            if kw.get("use_pallas") is False:
+                raise
+            kw = dict(kw, use_pallas=False)
+            return sharded_all_pairs_peak(data, wlen, mesh, ring=cfg, **kw)

@@ -1,0 +1,228 @@
+"""Input-health sentinel: one fused jitted screen, a per-channel mask.
+
+Real interrogators emit NaN/Inf bursts, flatlined channels, and saturated
+rails; the imaging pipeline's FFT chains turn ONE non-finite sample into a
+fully-poisoned dispersion image (NaN propagates through every rfft, norm,
+and mean it touches).  The sentinel screens a waterfall *before* the
+pipeline sees it:
+
+- **one fused program** — NaN/Inf counts, sample variance (flatline
+  detection), and clipping fraction per channel, plus the sanitized data,
+  all computed in a single jitted dispatch (``_screen``); the masking rule
+  itself reuses the :mod:`das_diff_veh_tpu.ops.qc` primitives
+  (``impute_traces`` for the neighbor fill);
+- **mask-aware sanitization** — non-finite samples become 0, unhealthy
+  channels are zeroed (and neighbor-imputed when ``HealthConfig.impute``),
+  so the existing mask-aware normalizations downstream (``vsg._postprocess``
+  divides where > 0, ``stack_gathers`` is ``where``-masked, the preprocess
+  imputes empty traces) degrade gracefully instead of averaging garbage;
+- **zero cost when off** — ``HealthConfig.enabled`` is False by default and
+  every call site checks it before calling in here; the per-tag dispatch
+  counters below let tests *assert* the zero-extra-dispatch claim instead
+  of trusting it.
+
+The host-side :func:`quick_screen` is the serve-admission variant: plain
+numpy, no device dispatch, cheap enough for ``submit`` — a poison request
+(NaN fraction / dead channels over the configured bounds) is shed with a
+structured report before it can join a microbatch cohort.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from das_diff_veh_tpu.config import HealthConfig
+from das_diff_veh_tpu.core.section import DasSection
+from das_diff_veh_tpu.ops.qc import impute_traces
+
+# per-call-site dispatch accounting: tests assert e.g. that the default
+# (disabled) config never screens inside process_chunk — the acceptance
+# bar "the sentinel adds zero extra dispatches" as a counter, not a claim
+_SCREENS_LOCK = threading.Lock()
+SCREENS_BY_TAG: Dict[str, int] = {}
+
+
+def n_screens(tag: Optional[str] = None) -> int:
+    with _SCREENS_LOCK:
+        if tag is not None:
+            return SCREENS_BY_TAG.get(tag, 0)
+        return sum(SCREENS_BY_TAG.values())
+
+
+def _count_screen(tag: str) -> None:
+    with _SCREENS_LOCK:
+        SCREENS_BY_TAG[tag] = SCREENS_BY_TAG.get(tag, 0) + 1
+
+
+class PoisonedChunkError(RuntimeError):
+    """A chunk whose masked-channel fraction exceeds
+    ``HealthConfig.max_masked_fraction`` — beyond degrading, the batch path
+    quarantines it instead of imaging noise."""
+
+    def __init__(self, health: "ChannelHealth"):
+        super().__init__(
+            f"chunk poisoned beyond the degradation ladder: "
+            f"{health.n_masked}/{health.n_channels} channels masked "
+            f"(nan_fraction={health.nan_fraction:.4f}, "
+            f"dead={health.n_dead}, clipped={health.n_clipped})")
+        self.health = health
+
+
+@dataclass(frozen=True)
+class ChannelHealth:
+    """Host-side screen verdict: the per-channel mask plus summary stats.
+
+    ``healthy`` is the :class:`ChannelHealthMask` the gather/VSG/stack path
+    consumes (True = keep); ``degraded`` says whether anything was masked
+    at all (the transition the obs counters and flight events record).
+    """
+
+    healthy: np.ndarray                 # (nch,) bool — the ChannelHealthMask
+    nan_fraction: float                 # global non-finite sample fraction
+    n_nonfinite_channels: int
+    n_dead: int                         # flatline / zero-variance channels
+    n_clipped: int
+
+    @property
+    def n_channels(self) -> int:
+        return int(self.healthy.size)
+
+    @property
+    def n_masked(self) -> int:
+        return int(self.n_channels - np.count_nonzero(self.healthy))
+
+    @property
+    def degraded(self) -> bool:
+        return self.n_masked > 0
+
+    def ok(self, cfg: HealthConfig) -> bool:
+        """Chunk-level verdict: masked fraction within the degrading bound."""
+        if self.n_channels == 0:
+            return True
+        return self.n_masked <= cfg.max_masked_fraction * self.n_channels
+
+    def summary(self) -> dict:
+        """Flight-record / manifest-friendly dict."""
+        return {"n_masked": self.n_masked,
+                "nan_fraction": round(self.nan_fraction, 6),
+                "n_nonfinite_channels": self.n_nonfinite_channels,
+                "n_dead": self.n_dead, "n_clipped": self.n_clipped}
+
+
+@partial(jax.jit, static_argnames=("flatline_var", "clip_limit",
+                                   "clip_fraction_max", "impute"))
+def _screen(data: jnp.ndarray, flatline_var: float, clip_limit: float,
+            clip_fraction_max: float, impute: bool):
+    """The fused sentinel: stats + mask + sanitized data, one program.
+
+    Returns ``(sanitized (nch, nt), healthy (nch,), n_nonfinite (nch,),
+    n_clipped_ch scalar, n_dead scalar)``.  Variance/clip stats are
+    computed on the zero-filled data so a NaN channel cannot poison its
+    own verdict.
+    """
+    finite = jnp.isfinite(data)
+    n_nonfinite = jnp.sum(~finite, axis=-1)             # (nch,)
+    clean = jnp.where(finite, data, 0.0)
+    # flatline = peak-to-peak span, not variance: an exactly-constant
+    # channel has ptp == 0.0 bit-for-bit, whereas float variance of a
+    # constant picks up mean-subtraction roundoff (~1e-34) and would slip
+    # past a zero threshold
+    ptp = jnp.max(clean, axis=-1) - jnp.min(clean, axis=-1)
+    dead = ptp <= flatline_var
+    if clip_limit > 0:
+        clip_frac = jnp.mean((jnp.abs(clean) >= clip_limit) & finite, axis=-1)
+        clipped = clip_frac >= clip_fraction_max
+    else:
+        clipped = jnp.zeros(data.shape[0], bool)
+    healthy = (n_nonfinite == 0) & ~dead & ~clipped
+    bad = ~healthy
+    masked = jnp.where(bad[:, None], 0.0, clean)
+    if impute:
+        # qc.impute_traces: neighbor SUM (edge channels copy the single
+        # neighbor) — the reference's per-channel rule, vectorized.  A bad
+        # channel whose neighbors are also bad imputes zeros, which the
+        # mask-aware normalizations downstream treat as absent.
+        masked = impute_traces(masked, bad)
+    return masked, healthy, n_nonfinite, jnp.sum(clipped), jnp.sum(dead)
+
+
+def screen_arrays(data, cfg: HealthConfig, tag: str = "direct"
+                  ) -> Tuple[jnp.ndarray, ChannelHealth]:
+    """Screen one (nch, nt) waterfall; returns (sanitized, verdict).
+
+    ONE device dispatch (the fused ``_screen`` program), counted under
+    ``tag`` in :data:`SCREENS_BY_TAG` so call sites stay auditable."""
+    data = jnp.asarray(data)
+    _count_screen(tag)
+    out, healthy, n_nonfinite, n_clipped, n_dead = _screen(
+        data, float(cfg.flatline_var), float(cfg.clip_limit),
+        float(cfg.clip_fraction_max), bool(cfg.impute))
+    n_nonfinite = np.asarray(n_nonfinite)
+    nt = max(int(data.shape[-1]), 1)
+    health = ChannelHealth(
+        healthy=np.asarray(healthy),
+        nan_fraction=float(n_nonfinite.sum()) / (n_nonfinite.size * nt),
+        n_nonfinite_channels=int(np.count_nonzero(n_nonfinite)),
+        n_dead=int(n_dead), n_clipped=int(n_clipped))
+    return out, health
+
+
+def screen_section(section: DasSection, cfg: HealthConfig,
+                   tag: str = "direct") -> Tuple[DasSection, ChannelHealth]:
+    """:func:`screen_arrays` on a :class:`DasSection` (axes pass through)."""
+    data, health = screen_arrays(section.data, cfg, tag=tag)
+    return DasSection(data, section.x, section.t), health
+
+
+def quick_screen(data: np.ndarray, cfg: HealthConfig) -> ChannelHealth:
+    """Host-side (numpy, zero-dispatch) screen for serve admission.
+
+    Same per-channel rules as the fused sentinel, evaluated on the request
+    thread: admission must not touch the device (a dispatch there would
+    serialize against the dispatcher's compute and break the zero-compile
+    accounting).  Returns the verdict only — sanitization happens on the
+    batch path; a served request is either admitted whole or shed."""
+    data = np.asarray(data)
+    finite = np.isfinite(data)
+    n_nonfinite = np.sum(~finite, axis=-1)
+    clean = np.where(finite, data, 0.0)
+    dead = np.ptp(clean, axis=-1) <= cfg.flatline_var   # same rule as _screen
+    if cfg.clip_limit > 0:
+        clip_frac = np.mean((np.abs(clean) >= cfg.clip_limit) & finite,
+                            axis=-1)
+        clipped = clip_frac >= cfg.clip_fraction_max
+    else:
+        clipped = np.zeros(data.shape[0], bool)
+    healthy = (n_nonfinite == 0) & ~dead & ~clipped
+    nt = max(int(data.shape[-1]), 1)
+    return ChannelHealth(
+        healthy=healthy,
+        nan_fraction=float(n_nonfinite.sum()) / (n_nonfinite.size * nt),
+        n_nonfinite_channels=int(np.count_nonzero(n_nonfinite)),
+        n_dead=int(np.count_nonzero(dead)),
+        n_clipped=int(np.count_nonzero(clipped)))
+
+
+def admission_verdict(health: ChannelHealth,
+                      cfg: HealthConfig) -> Optional[str]:
+    """Serve-admission poison rule: a rejection reason, or None to admit.
+
+    Stricter than the batch path's :meth:`ChannelHealth.ok` on purpose —
+    batch chunks degrade (mask + continue) because the data is already on
+    disk; a served request can be fixed and resubmitted by its caller, so
+    ANY non-finite content beyond ``nan_fraction_max`` is shed."""
+    if health.nan_fraction > cfg.nan_fraction_max:
+        return (f"non-finite sample fraction {health.nan_fraction:.4f} "
+                f"exceeds the admission bound {cfg.nan_fraction_max}")
+    if not health.ok(cfg):
+        return (f"{health.n_masked}/{health.n_channels} channels unhealthy "
+                f"(dead={health.n_dead}, clipped={health.n_clipped}) — over "
+                f"the max_masked_fraction={cfg.max_masked_fraction} bound")
+    return None

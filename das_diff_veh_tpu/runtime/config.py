@@ -31,6 +31,15 @@ class RuntimeConfig:
     """Sleep before retry attempt k is ``k * retry_backoff_s`` (linear
     backoff; transient NFS/device hiccups clear in well under a second)."""
 
+    retry_quarantined: bool = False
+    """Resume policy for chunks the manifest already recorded as
+    quarantined.  False (default): a restart *skips* known-bad chunks —
+    they settled once through the full retry ladder and re-failing them on
+    every restart would turn one bad file into a per-restart tax.  True:
+    their quarantine records are cleared and they re-enter the work list
+    (use after fixing the underlying fault — a restored NFS mount, a
+    repaired file)."""
+
     device_put: bool = True
     """Stage the loaded waterfall onto the default device from the loader
     thread (`jax.device_put`), overlapping H2D transfer with compute."""

@@ -21,6 +21,7 @@ from typing import Any, Callable, List, Optional, Sequence
 
 from das_diff_veh_tpu.obs.flight import FlightRecorder
 from das_diff_veh_tpu.obs.registry import MetricsRegistry, default_registry
+from das_diff_veh_tpu.resilience import faults
 from das_diff_veh_tpu.runtime.config import RuntimeConfig
 from das_diff_veh_tpu.runtime.prefetch import PrefetchLoader
 from das_diff_veh_tpu.runtime.tracing import NullTracer
@@ -82,12 +83,18 @@ class ExecStats:
 
 
 def _retrying(fn: Callable[[], Any], stage: str, key: str, cfg: RuntimeConfig,
-              tracer, stats: ExecStats, prior_error: Optional[Exception] = None):
+              tracer, stats: ExecStats, prior_error: Optional[Exception] = None,
+              on_failure: Optional[Callable] = None):
     """Run ``fn`` with up to max_retries extra attempts; returns
     (value, error, n_retries_used).  ``prior_error`` marks an attempt that
     already failed elsewhere (the prefetch thread), so every call here is a
-    counted, backed-off retry."""
+    counted, backed-off retry.  ``on_failure(stage, key, error, attempt)``
+    fires once per failed attempt *before* the next retry — the hook the
+    degradation ladder rides (demote the fancy path so the retry runs the
+    fallback)."""
     err: Optional[Exception] = prior_error
+    if err is not None and on_failure is not None:
+        on_failure(stage, key, err, 0)
     first = 1 if prior_error is not None else 0
     for attempt in range(first, cfg.max_retries + 1):
         if attempt:
@@ -100,6 +107,8 @@ def _retrying(fn: Callable[[], Any], stage: str, key: str, cfg: RuntimeConfig,
             return fn(), None, attempt
         except Exception as e:
             err = e
+            if on_failure is not None:
+                on_failure(stage, key, e, attempt)
     return None, err, cfg.max_retries
 
 
@@ -111,12 +120,16 @@ def run_pipelined(tasks: Sequence[ChunkTask],
                   on_quarantine: Optional[Callable[[QuarantineRecord], None]] = None,
                   registry: Optional[MetricsRegistry] = None,
                   flight: Optional[FlightRecorder] = None,
+                  on_stage_failure: Optional[Callable] = None,
                   ) -> ExecStats:
     """Execute every task; never raises for a per-chunk failure.
 
     ``compute`` runs device work for one loaded value; ``accumulate`` folds
     its result into caller state (called in task order).  ``on_quarantine``
-    fires once per permanently-failed chunk (manifest bookkeeping).
+    fires once per permanently-failed chunk (manifest bookkeeping);
+    ``on_stage_failure(stage, key, error, attempt)`` once per failed
+    attempt before its retry (the degradation ladder's hook — demote a
+    flaky code path so the retry takes the fallback).
 
     Chunk progress, retries, quarantines, per-chunk wall time, and the live
     prefetch queue depth register as ``das_runtime_*`` families into
@@ -167,7 +180,8 @@ def run_pipelined(tasks: Sequence[ChunkTask],
                 log.warning("%s: load failed: %s", task.key, err)
                 value, err, retries = _retrying(task.load, "load", task.key,
                                                 cfg, tracer, stats,
-                                                prior_error=err)
+                                                prior_error=err,
+                                                on_failure=on_stage_failure)
                 if retries:
                     c_retries.labels(stage="load").inc(retries)
             if err is not None:
@@ -184,11 +198,16 @@ def run_pipelined(tasks: Sequence[ChunkTask],
                 continue
 
             def _compute(v=value):
+                # chaos sites: slow-chunk latency + compute dispatch failure
+                # (no-ops unless a fault injector is installed)
+                faults.fire("runtime.slow", task.key)
+                faults.fire("runtime.compute", task.key)
                 with tracer.span("compute", key=task.key):
                     return compute(v)
 
             result, err, retries = _retrying(_compute, "compute", task.key,
-                                             cfg, tracer, stats)
+                                             cfg, tracer, stats,
+                                             on_failure=on_stage_failure)
             if retries:
                 c_retries.labels(stage="compute").inc(retries)
             if err is not None:

@@ -67,7 +67,10 @@ class RunManifest:
     complete: bool = False
     files: Dict[str, dict] = field(default_factory=dict)
     """basename -> {"status": done|quarantined, "n_windows": int,
-    "error": str, "stage": str, "retries": int} (keys per status)."""
+    "error": str, "stage": str, "retries": int, "health": dict}
+    (keys per status; "health" only on chunks the input-health sentinel
+    degraded — masked channels, NaN fraction — so a resumed run still
+    knows which of its accumulated chunks ran in degraded mode)."""
 
     # -- persistence ---------------------------------------------------------
     @classmethod
@@ -99,14 +102,27 @@ class RunManifest:
         """Done or quarantined — nothing left to do for this chunk."""
         return self.status(key) in (STATUS_DONE, STATUS_QUARANTINED)
 
-    def mark_done(self, key: str, n_windows: int, retries: int = 0) -> None:
-        self.files[key] = {"status": STATUS_DONE, "n_windows": int(n_windows),
-                           "retries": int(retries)}
+    def mark_done(self, key: str, n_windows: int, retries: int = 0,
+                  health: Optional[dict] = None) -> None:
+        entry = {"status": STATUS_DONE, "n_windows": int(n_windows),
+                 "retries": int(retries)}
+        if health:     # degraded-mode provenance (masked channels etc.)
+            entry["health"] = health
+        self.files[key] = entry
 
     def mark_quarantined(self, key: str, stage: str, error: str,
                          retries: int = 0) -> None:
         self.files[key] = {"status": STATUS_QUARANTINED, "stage": stage,
                            "error": error[:500], "retries": int(retries)}
+
+    def clear_quarantined(self) -> int:
+        """Drop every quarantine record so those chunks re-enter the work
+        list (``RuntimeConfig.retry_quarantined``); returns how many."""
+        keys = [k for k, e in self.files.items()
+                if e["status"] == STATUS_QUARANTINED]
+        for k in keys:
+            del self.files[k]
+        return len(keys)
 
     @property
     def n_vehicles(self) -> int:
@@ -123,3 +139,9 @@ class RunManifest:
     def quarantined(self) -> Dict[str, dict]:
         return {k: e for k, e in self.files.items()
                 if e["status"] == STATUS_QUARANTINED}
+
+    @property
+    def degraded(self) -> Dict[str, dict]:
+        """Done chunks that ran with health-masked channels."""
+        return {k: e for k, e in self.files.items()
+                if e["status"] == STATUS_DONE and e.get("health")}

@@ -27,8 +27,9 @@ from das_diff_veh_tpu.serve.compile_cache import (CompiledFunctionCache,
 from das_diff_veh_tpu.serve.engine import (DeadlineExceededError,
                                            EngineClosedError,
                                            InvalidRequestError, NoBucketError,
-                                           QueueFullError, ServingEngine,
-                                           ShedError)
+                                           PoisonInputError, QueueFullError,
+                                           ServingEngine, ShedError,
+                                           ShutdownError)
 from das_diff_veh_tpu.serve.http import make_server, serve_in_thread
 from das_diff_veh_tpu.serve.imaging import ImagingComputeFactory, ImagingResult
 from das_diff_veh_tpu.serve.metrics import ServeMetrics
@@ -39,7 +40,7 @@ __all__ = [
     "CompiledFunctionCache", "ImagingComputeFactory", "ImagingResult",
     "ServeMetrics", "SessionStore", "ShedError", "QueueFullError",
     "DeadlineExceededError", "NoBucketError", "InvalidRequestError",
-    "EngineClosedError",
+    "PoisonInputError", "EngineClosedError", "ShutdownError",
     "normalize_buckets", "pick_bucket", "pad_section", "unpad",
     "make_server", "serve_in_thread",
 ]

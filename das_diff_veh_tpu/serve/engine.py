@@ -33,6 +33,7 @@ compute function in execution order (see serve/session.py).
 
 from __future__ import annotations
 
+import itertools
 import logging
 import queue
 import threading
@@ -48,6 +49,7 @@ from das_diff_veh_tpu.obs import xla_events
 from das_diff_veh_tpu.obs.flight import FlightRecorder
 from das_diff_veh_tpu.obs.profiling import HBMSampler
 from das_diff_veh_tpu.obs.registry import MetricsRegistry
+from das_diff_veh_tpu.resilience import faults
 from das_diff_veh_tpu.runtime.tracing import NullTracer
 from das_diff_veh_tpu.serve.buckets import (Bucket, normalize_buckets,
                                             pad_section, pick_bucket)
@@ -80,8 +82,27 @@ class InvalidRequestError(ShedError):
     geometry that does not match the warmed programs)."""
 
 
+class PoisonInputError(InvalidRequestError):
+    """The admission-time health screen rejected the request: NaN/Inf
+    content or dead/clipped channels beyond ``ServeConfig.health`` bounds.
+    Shed *before* queueing so one poison request can never contaminate a
+    microbatch cohort's shared dispatch window.  Carries the structured
+    :class:`~das_diff_veh_tpu.resilience.health.ChannelHealth` report the
+    HTTP front renders as a 422 body."""
+
+    def __init__(self, reason: str, health):
+        super().__init__(reason)
+        self.health = health
+
+
 class EngineClosedError(RuntimeError):
     """submit() after close()."""
+
+
+class ShutdownError(EngineClosedError):
+    """The engine was closed while this request was still pending and the
+    dispatcher could not be joined (wedged in a long compute): the future
+    is failed with this instead of hanging its caller forever."""
 
 
 @dataclass
@@ -129,6 +150,13 @@ class ServingEngine:
         self.cache = CompiledFunctionCache(factory, self._metrics)
         self._queue: queue.Queue = queue.Queue(maxsize=self.cfg.max_queue)
         self._stash: deque = deque()   # dequeued, deferred to a later batch
+        # requests dequeued into the dispatcher's current batch but not yet
+        # executing: a wedged close() must fail these too (they are in
+        # neither the queue nor the stash).  Guarded by _backlog_lock so the
+        # close-path snapshot never races the dispatcher's append/popleft.
+        self._batch_backlog: deque = deque()
+        self._backlog_lock = threading.Lock()
+        self._dispatch_seq = itertools.count()   # serve.dispatch fault keys
         self._closed = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._metrics.bind_queue_depth(
@@ -183,15 +211,45 @@ class ServingEngine:
         if self._thread is not None:
             self._thread.join(timeout=timeout)
             if self._thread.is_alive():
-                # still draining a long compute; it owns the queue until it
-                # exits, so leave pending futures to it
+                # wedged in a long compute: it owns the request it is
+                # currently executing, but everything still queued, stashed,
+                # or dequeued into its unexecuted batch tail would otherwise
+                # hang its caller on .result() forever — fail those futures
+                # NOW.  Futures are only ever resolved
+                # through done()-guarded set_result/set_exception calls, so
+                # if the dispatcher later unwedges it skips them cleanly.
+                n = (self._queue.qsize() + len(self._stash)
+                     + len(self._batch_backlog))
                 log.warning("dispatcher did not exit within %.1fs (compute "
-                            "still running); leaving it to finish", timeout)
+                            "still running); failing %d pending requests "
+                            "with ShutdownError", timeout, n)
+                self._fail_pending(ShutdownError(
+                    f"engine closed while the dispatcher was wedged "
+                    f"(did not exit within {timeout:.1f}s)"), drain=False)
                 return
             self._thread = None
         self._fail_pending(EngineClosedError("engine closed"))
 
-    def _fail_pending(self, exc: Exception) -> None:
+    def _fail_pending(self, exc: Exception, drain: bool = True) -> None:
+        """Fail queued/stashed futures.  ``drain=True`` (dispatcher gone):
+        pop everything via the normal path.  ``drain=False`` (dispatcher
+        wedged but alive): fail the stash and the dispatcher's current
+        batch backlog over *snapshots* without mutating their deques (the
+        dispatcher owns them — it skips done() futures when it unwedges),
+        and pop only from the thread-safe admission queue."""
+        if not drain:
+            with self._backlog_lock:
+                backlog = list(self._batch_backlog)
+            for req in backlog + list(self._stash):
+                if not req.future.done():
+                    req.future.set_exception(exc)
+            while True:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    return
+                if not req.future.done():
+                    req.future.set_exception(exc)
         while True:
             req = self._next_request(timeout=0.0)
             if req is None:
@@ -221,6 +279,21 @@ class ServingEngine:
             self._metrics.inc("shed_invalid")
             self._record_shed("invalid", valid, bucket, session, reason=reason)
             raise InvalidRequestError(reason)
+        hcfg = self.cfg.health
+        if hcfg is not None and hcfg.enabled:
+            # zero-dispatch numpy screen on the request thread: a poison
+            # request (NaN/Inf burst, dead-channel flood) is shed HERE so
+            # it can never share a microbatch window with healthy cohort
+            # members — the 422 path (docs/ROBUSTNESS.md)
+            from das_diff_veh_tpu.resilience.health import (admission_verdict,
+                                                            quick_screen)
+            health = quick_screen(section.data, hcfg)
+            verdict = admission_verdict(health, hcfg)
+            if verdict is not None:
+                self._metrics.inc("shed_poison")
+                self._record_shed("poison", valid, bucket, session,
+                                  **health.summary())
+                raise PoisonInputError(verdict, health)
         if deadline_ms is None:
             deadline_ms = self.cfg.default_deadline_ms
         now = time.perf_counter()
@@ -331,12 +404,16 @@ class ServingEngine:
             if self._expired(head):
                 continue
             batch = [head]
+            with self._backlog_lock:
+                self._batch_backlog.append(head)
             linger_end = time.perf_counter() + self.cfg.batch_window_ms / 1e3
             while len(batch) < self.cfg.max_batch:
                 nxt = self._next_same_bucket(head.bucket, linger_end)
                 if nxt is None:
                     break
                 batch.append(nxt)
+                with self._backlog_lock:
+                    self._batch_backlog.append(nxt)
             self._execute(batch)
 
     def _execute(self, batch) -> None:
@@ -345,12 +422,20 @@ class ServingEngine:
         self._metrics.observe_batch(len(batch))
         self.tracer.counter("serve_batch", occupancy=len(batch))
         for req in batch:
+            with self._backlog_lock:   # req is now in-flight, not backlog
+                if self._batch_backlog and self._batch_backlog[0] is req:
+                    self._batch_backlog.popleft()
+            if req.future.done():      # failed by a wedged-dispatcher close
+                continue
             if self._expired(req):     # deadline may pass while batching
                 continue
             t_dq = time.perf_counter()
             self.tracer.complete("queue", req.t_submit_us, cat="serve",
                                  bucket=list(bucket))
             try:
+                # chaos site: per-request dispatch failure INSIDE the try —
+                # an injected fault fails this one future, not the cohort
+                faults.fire("serve.dispatch", next(self._dispatch_seq))
                 t0 = time.perf_counter()
                 with self.tracer.span("pad", cat="serve",
                                       valid=list(req.valid),

@@ -17,7 +17,10 @@ Shed responses map onto HTTP status codes: 429 for backpressure
 (queue full), 504 for a deadline that expired in queue, 413 for a shape no
 bucket fits, 400 for malformed payloads and for requests the compute
 factory's admission check rejects (e.g. geometry that does not match the
-warmed programs).
+warmed programs), and 422 for poison inputs the admission health screen
+sheds (NaN/Inf bursts, dead-channel floods) — the 422 body is structured
+(``{"error", "nan_fraction", "dead_channels"}``) so the producer side can
+diagnose its interrogator instead of parsing prose.
 """
 
 from __future__ import annotations
@@ -33,7 +36,8 @@ import numpy as np
 from das_diff_veh_tpu.core.section import DasSection
 from das_diff_veh_tpu.serve.engine import (DeadlineExceededError,
                                            InvalidRequestError, NoBucketError,
-                                           QueueFullError, ServingEngine)
+                                           PoisonInputError, QueueFullError,
+                                           ServingEngine)
 
 
 def _jsonable(obj, full_arrays: bool = False):
@@ -127,6 +131,15 @@ class ServeHandler(BaseHTTPRequestHandler):
             return
         except NoBucketError as e:
             self._reply(413, {"error": str(e)})
+            return
+        except PoisonInputError as e:
+            # 422: syntactically fine, semantically unprocessable — the
+            # structured body tells the caller WHAT is poisoned so the
+            # producer side can be fixed (422 before 400: Poison subclasses
+            # InvalidRequestError)
+            self._reply(422, {"error": str(e),
+                              "nan_fraction": e.health.nan_fraction,
+                              "dead_channels": e.health.n_masked})
             return
         except InvalidRequestError as e:
             self._reply(400, {"error": str(e)})

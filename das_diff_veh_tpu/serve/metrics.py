@@ -38,7 +38,8 @@ class ServeMetrics:
     _STAGES = ("queue", "pad", "compute", "unpad")
     _COUNTS = ("submitted", "completed", "errors",
                "shed_rejected", "shed_expired", "shed_no_bucket",
-               "shed_invalid", "cache_hits", "cache_misses", "warmup_builds")
+               "shed_invalid", "shed_poison",
+               "cache_hits", "cache_misses", "warmup_builds")
 
     def __init__(self, latency_window: int = 1024,
                  registry: Optional[MetricsRegistry] = None):

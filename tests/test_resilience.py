@@ -1,0 +1,594 @@
+"""Resilience tests: fault injection, health sentinel, degradation ladder,
+and the seeded chaos campaign (ISSUE 7).
+
+Everything here is stub-compute cheap — no ``process_chunk`` traces, no new
+compile shapes; the one test that touches the real pipeline consumes the
+session-scoped ``chunk_result_xcorr`` fixture (conftest.py) read-only to
+counter-assert the sentinel's zero-dispatch-when-disabled contract.  The
+``chaos``-marked campaign drives the REAL ``run_directory`` workflow (real
+npz I/O, real prefetch threads, real manifest/flight artifacts) under a
+seeded :class:`FaultPlan` and asserts plan-exact outcomes.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from das_diff_veh_tpu.config import HealthConfig, PipelineConfig
+from das_diff_veh_tpu.core.section import DasSection
+from das_diff_veh_tpu.io.readers import (DirectoryDataset, read_npz_section,
+                                         save_section_npz)
+from das_diff_veh_tpu.obs.flight import FlightRecorder, load_flight_dump
+from das_diff_veh_tpu.obs.registry import MetricsRegistry, default_registry
+from das_diff_veh_tpu.pipeline.workflow import run_directory
+from das_diff_veh_tpu.resilience import degrade, faults, health
+from das_diff_veh_tpu.resilience.faults import (FaultPlan, FaultSpec,
+                                                InjectedFault)
+from das_diff_veh_tpu.resilience.health import (PoisonedChunkError,
+                                                admission_verdict,
+                                                quick_screen, screen_arrays,
+                                                screen_section)
+from das_diff_veh_tpu.runtime import ChunkTask, RuntimeConfig, run_pipelined
+
+DATE = "20230301"
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    """No injector and no ladder leaks across tests — both are process-wide
+    and sticky by design."""
+    faults.uninstall()
+    degrade.set_ladder(None)
+    yield
+    faults.uninstall()
+    degrade.set_ladder(None)
+
+
+def _counter_value(reg, name, **labels):
+    fam = reg.get(name)
+    if fam is None:
+        return 0.0
+    child = fam.labels(**labels) if labels else fam
+    return child.value
+
+
+# --------------------------------------------------------------------------
+# fault injector
+# --------------------------------------------------------------------------
+
+def test_fire_and_corrupt_are_noops_when_disabled():
+    data = np.ones((4, 8))
+    faults.fire("io.read", "a.npz")              # no injector: returns
+    assert faults.corrupt("io.corrupt", "a.npz", data) is data  # same object
+
+
+def test_error_spec_fires_only_on_matching_keys():
+    plan = FaultPlan(specs=(FaultSpec("io.read", "error", keys=("b.npz",)),))
+    with faults.injected(plan, registry=MetricsRegistry()) as inj:
+        faults.fire("io.read", "a.npz")          # wrong key: silent
+        faults.fire("runtime.compute", "b.npz")  # wrong site: silent
+        with pytest.raises(InjectedFault) as exc:
+            faults.fire("io.read", "b.npz")
+        assert exc.value.site == "io.read"
+        assert inj.n_injected == 1
+    # context manager cleaned up
+    faults.fire("io.read", "b.npz")
+
+
+def test_corruption_is_deterministic_per_key_and_counted():
+    reg = MetricsRegistry()
+    plan = FaultPlan(specs=(FaultSpec("io.corrupt", "nan", keys=("k",),
+                                      param=0.25),), seed=11)
+    rng = np.random.default_rng(3)
+    data = rng.standard_normal((8, 64))
+    with faults.injected(plan, registry=reg) as inj:
+        out1 = inj.corrupt("io.corrupt", "k", data)
+        out2 = inj.corrupt("io.corrupt", "k", data)
+    assert out1 is not data and not np.isnan(data).any()   # copy, not mutation
+    assert np.isnan(out1).any()
+    # a retry of the same chunk refires the identical corruption
+    assert np.array_equal(np.isnan(out1), np.isnan(out2))
+    assert _counter_value(reg, "das_faults_injected_total",
+                          site="io.corrupt", kind="nan") == 2
+
+
+def test_dead_and_clip_kinds():
+    plan = FaultPlan(specs=(
+        FaultSpec("io.corrupt", "dead", keys=("k",), channels=(1, 3)),
+        FaultSpec("io.corrupt", "clip", keys=("k",), channels=(5,),
+                  param=2.0)))
+    data = np.random.default_rng(0).standard_normal((8, 32))
+    with faults.injected(plan, registry=MetricsRegistry()) as inj:
+        out = inj.corrupt("io.corrupt", "k", data)
+    assert not out[1].any() and not out[3].any()
+    assert np.all(np.abs(out[5]) == 2.0)
+    assert np.array_equal(out[0], data[0])       # untargeted rows untouched
+
+
+def test_slow_spec_sleeps_then_error_spec_raises():
+    plan = FaultPlan(specs=(FaultSpec("io.read", "slow", param=0.05),
+                            FaultSpec("io.read", "error")))
+    with faults.injected(plan, registry=MetricsRegistry()):
+        t0 = time.perf_counter()
+        with pytest.raises(InjectedFault):
+            faults.fire("io.read", "anything")
+        assert time.perf_counter() - t0 >= 0.05
+
+
+def test_plan_sample_is_seeded_and_disjoint():
+    keys = [f"{i:02d}.npz" for i in range(10)]
+    a = FaultPlan.sample(7, keys, n_loader_faults=3, n_corrupt=2)
+    b = FaultPlan.sample(7, keys, n_loader_faults=3, n_corrupt=2)
+    assert a == b                                 # deterministic
+    read = next(s for s in a.specs if s.site == "io.read")
+    corrupt = next(s for s in a.specs if s.site == "io.corrupt")
+    assert len(read.keys) == 3 and len(corrupt.keys) == 2
+    assert not set(read.keys) & set(corrupt.keys)
+    assert a.n_keys("io.read") == 3 and a.n_keys("io.corrupt") == 2
+    with pytest.raises(ValueError):
+        FaultPlan.sample(0, keys[:3], n_loader_faults=2, n_corrupt=2)
+
+
+def test_reader_sites_end_to_end(tmp_path):
+    sec = DasSection(np.random.default_rng(1).standard_normal((6, 128)),
+                     np.arange(6.0), np.arange(128) / 250.0)
+    path = str(tmp_path / "chunk.npz")
+    save_section_npz(path, sec)
+    clean = read_npz_section(path, cut_taper=False)
+    plan = FaultPlan(specs=(
+        FaultSpec("io.read", "error", keys=("other.npz",)),
+        FaultSpec("io.corrupt", "dead", keys=("chunk.npz",),
+                  channels=(2,))))
+    with faults.injected(plan, registry=MetricsRegistry()):
+        got = read_npz_section(path, cut_taper=False)   # io.read key mismatch
+        assert not np.asarray(got.data)[2].any()
+        assert np.array_equal(np.asarray(got.data)[0],
+                              np.asarray(clean.data)[0])
+    plan2 = FaultPlan(specs=(FaultSpec("io.read", "error",
+                                       keys=("chunk.npz",)),))
+    with faults.injected(plan2, registry=MetricsRegistry()):
+        with pytest.raises(InjectedFault):
+            read_npz_section(path, cut_taper=False)
+
+
+# --------------------------------------------------------------------------
+# health sentinel
+# --------------------------------------------------------------------------
+
+def _waterfall(nch=12, nt=200, seed=0):
+    return np.random.default_rng(seed).standard_normal((nch, nt))
+
+
+def test_sentinel_masks_nan_flatline_and_clipped_channels():
+    cfg = HealthConfig(enabled=True, clip_limit=5.0, clip_fraction_max=0.1)
+    data = _waterfall()
+    data[2, 50:80] = np.nan
+    data[5, 10] = np.inf
+    data[7] = 0.123                               # flatlined
+    data[9] = 6.0 * np.sign(data[9] + 0.01)       # saturated rail
+    san, h = screen_arrays(data, cfg, tag="unit")
+    assert not h.healthy[2] and not h.healthy[5]
+    assert not h.healthy[7] and not h.healthy[9]
+    assert h.healthy[[0, 1, 3, 4, 6, 8, 10, 11]].all()
+    assert h.n_masked == 4 and h.degraded
+    assert h.n_nonfinite_channels == 2 and h.n_dead == 1 and h.n_clipped == 1
+    assert h.nan_fraction == pytest.approx(31 / data.size)
+    san = np.asarray(san)
+    assert np.isfinite(san).all()
+    # healthy channels pass through bit-identically
+    for c in (0, 1, 3, 4, 6, 8, 10, 11):
+        assert np.array_equal(san[c], data[c])
+    # masked channels are neighbor-imputed (qc.impute_traces rule)
+    assert np.array_equal(san[7], san[6] + san[8])
+
+
+def test_sentinel_clean_data_is_bit_identical_and_not_degraded():
+    cfg = HealthConfig(enabled=True)
+    data = _waterfall(seed=4)
+    san, h = screen_arrays(data, cfg, tag="unit")
+    assert h.healthy.all() and not h.degraded and h.ok(cfg)
+    assert np.array_equal(np.asarray(san), data)
+
+
+def test_quick_screen_matches_fused_sentinel():
+    cfg = HealthConfig(enabled=True, clip_limit=4.0)
+    data = _waterfall(seed=5)
+    data[1, :20] = np.nan
+    data[3] = 0.0
+    _, fused = screen_arrays(data, cfg, tag="unit")
+    quick = quick_screen(data, cfg)
+    assert np.array_equal(quick.healthy, np.asarray(fused.healthy))
+    assert quick.summary() == fused.summary()
+
+
+def test_poison_verdicts():
+    cfg = HealthConfig(enabled=True, max_masked_fraction=0.25)
+    data = _waterfall(nch=8)
+    data[:4] = np.nan                             # half the fiber gone
+    _, h = screen_arrays(data, cfg, tag="unit")
+    assert not h.ok(cfg)
+    assert admission_verdict(h, cfg) is not None
+    with pytest.raises(PoisonedChunkError):
+        raise PoisonedChunkError(h)
+    ok = quick_screen(_waterfall(seed=6), cfg)
+    assert admission_verdict(ok, cfg) is None
+
+
+def test_screen_section_preserves_axes():
+    cfg = HealthConfig(enabled=True)
+    sec = DasSection(_waterfall(), np.arange(12.0), np.arange(200) / 250.0)
+    out, _ = screen_section(sec, cfg, tag="unit")
+    assert out.x is sec.x and out.t is sec.t
+
+
+def test_sentinel_zero_dispatches_in_default_process_chunk(chunk_result_xcorr):
+    """The acceptance bar, counter-asserted: the session's canonical
+    ``process_chunk`` run (default config — health disabled) performed ZERO
+    health screens, and its result carries no health verdict.  Every screen
+    increments ``SCREENS_BY_TAG[tag]``; nothing in tier-1 screens under the
+    "process_chunk" tag, so this holds regardless of test order."""
+    assert chunk_result_xcorr.health is None
+    assert health.n_screens("process_chunk") == 0
+
+
+# --------------------------------------------------------------------------
+# degradation ladder
+# --------------------------------------------------------------------------
+
+def test_ladder_thresholds_counters_and_flight():
+    reg = MetricsRegistry()
+    flight = FlightRecorder(capacity=16)
+    lad = degrade.DegradationLadder(registry=reg, flight=flight, threshold=2)
+    assert not lad.note_failure("gather.fused", ValueError("once"))
+    assert not lad.demoted("gather.fused")
+    assert lad.note_failure("gather.fused", ValueError("twice"))
+    assert lad.demoted("gather.fused")
+    lad.note_failure("gather.fused")              # idempotent past threshold
+    assert _counter_value(reg, "das_degrade_transitions_total",
+                          component="gather.fused") == 1
+    assert _counter_value(reg, "das_degrade_active",
+                          component="gather.fused") == 1
+    recs = [r for r in flight.records() if r["kind"] == "degrade"]
+    assert len(recs) == 1 and recs[0]["component"] == "gather.fused"
+    lad.reset("gather.fused")
+    assert not lad.demoted("gather.fused")
+    assert _counter_value(reg, "das_degrade_active",
+                          component="gather.fused") == 0
+
+
+def test_auto_gather_mode_honors_demotion(monkeypatch):
+    """Rung 2: once ``gather.fused`` is demoted, ``traj_gather="auto"`` on a
+    TPU backend resolves to the serialized cut; the explicit "fused"
+    override still forces the kernel."""
+    import jax
+
+    from das_diff_veh_tpu.ops.xcorr import _decide_traj_gather
+
+    degrade.set_ladder(degrade.DegradationLadder(registry=MetricsRegistry()))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert _decide_traj_gather("auto", 8, 128, "rfft") is True
+    degrade.note_failure(degrade.GATHER_FUSED, RuntimeError("kernel died"))
+    assert _decide_traj_gather("auto", 8, 128, "rfft") is False
+    assert _decide_traj_gather("fused", 8, 128, "rfft") is True
+    assert _decide_traj_gather("serialized", 8, 128, "rfft") is False
+
+
+def test_ring_fault_falls_back_to_replicated_bit_identical():
+    """Rung 3: an injected ring failure degrades to the replicated layout
+    (same result — it is the same einsum program), demotes the component,
+    and the NEXT call skips the ring without re-failing."""
+    from das_diff_veh_tpu.config import RingConfig
+    from das_diff_veh_tpu.parallel import make_mesh, sharded_all_pairs_peak
+
+    reg = MetricsRegistry()
+    degrade.set_ladder(degrade.DegradationLadder(registry=reg))
+    mesh = make_mesh(8)
+    data = np.random.default_rng(2).standard_normal((16, 512)).astype(
+        np.float32)
+    ref = sharded_all_pairs_peak(data, 64, mesh, use_pallas=False,
+                                 ring=RingConfig(mode="replicated"),
+                                 registry=reg)
+    plan = FaultPlan(specs=(FaultSpec("parallel.ring", "error"),))
+    with faults.injected(plan, registry=reg) as inj:
+        out = degrade.resilient_all_pairs_peak(data, 64, mesh,
+                                               use_pallas=False, registry=reg)
+        assert inj.n_injected == 1
+        assert degrade.demoted(degrade.PARALLEL_RING)
+        # demoted: goes straight to replicated, the ring site never fires
+        out2 = degrade.resilient_all_pairs_peak(data, 64, mesh,
+                                                use_pallas=False,
+                                                registry=reg)
+        assert inj.n_injected == 1
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(ref))
+    assert _counter_value(reg, "das_degrade_transitions_total",
+                          component="parallel.ring") == 1
+
+
+def test_validation_error_reraises_without_demotion():
+    """A pre-dispatch input-validation error (caller bug) re-raises from
+    resilient_all_pairs_peak untouched: every rung would fail identically,
+    so it must not burn a demotion or run the fallback ladder."""
+    from das_diff_veh_tpu.parallel import make_mesh
+
+    reg = MetricsRegistry()
+    degrade.set_ladder(degrade.DegradationLadder(registry=reg))
+    mesh = make_mesh(8)
+    data = np.random.default_rng(3).standard_normal((16, 512)).astype(
+        np.float32)
+    with pytest.raises(ValueError):
+        degrade.resilient_all_pairs_peak(data, 64, mesh, win_block=-4,
+                                         registry=reg)
+    assert not degrade.demoted(degrade.PARALLEL_RING)
+    assert _counter_value(reg, "das_degrade_transitions_total",
+                          component="parallel.ring") == 0
+
+
+# --------------------------------------------------------------------------
+# executor integration: sites + on_stage_failure hook
+# --------------------------------------------------------------------------
+
+def test_executor_compute_site_quarantines_and_reports_failures():
+    plan = FaultPlan(specs=(FaultSpec("runtime.compute", "error",
+                                      keys=("bad",)),))
+    seen = []
+    acc = []
+    tasks = [ChunkTask(i, k, lambda k=k: k) for i, k in
+             enumerate(["a", "bad", "c"])]
+    with faults.injected(plan, registry=MetricsRegistry()):
+        stats = run_pipelined(
+            tasks, compute=lambda v: v, accumulate=lambda t, r: acc.append(r),
+            cfg=RuntimeConfig(max_retries=1, retry_backoff_s=0.0),
+            on_stage_failure=lambda st, k, e, at: seen.append((st, k, at)))
+    assert acc == ["a", "c"]
+    assert [q.key for q in stats.quarantined] == ["bad"]
+    assert "InjectedFault" in stats.quarantined[0].error
+    # one initial failure + one failed retry, both reported to the hook
+    assert seen == [("compute", "bad", 0), ("compute", "bad", 1)]
+
+
+def test_executor_slow_site_delays_but_completes():
+    plan = FaultPlan(specs=(FaultSpec("runtime.slow", "slow", keys=("a",),
+                                      param=0.05),))
+    acc = []
+    with faults.injected(plan, registry=MetricsRegistry()):
+        t0 = time.perf_counter()
+        stats = run_pipelined([ChunkTask(0, "a", lambda: "v")],
+                              compute=lambda v: v,
+                              accumulate=lambda t, r: acc.append(r),
+                              cfg=RuntimeConfig(max_retries=0))
+        dt = time.perf_counter() - t0
+    assert acc == ["v"] and stats.n_done == 1 and not stats.quarantined
+    assert dt >= 0.05
+
+
+# --------------------------------------------------------------------------
+# the seeded chaos campaign (the acceptance-criteria test)
+# --------------------------------------------------------------------------
+
+N_FILES = 8
+N_LOADER = 2
+N_CORRUPT = 2
+
+
+def _write_dir(root):
+    day = os.path.join(str(root), DATE)
+    os.makedirs(day, exist_ok=True)
+    keys = []
+    for i in range(N_FILES):
+        rng = np.random.default_rng(100 + i)
+        sec = DasSection(rng.standard_normal((10, 256)) * (1.0 + 0.1 * i),
+                         np.arange(10.0), np.arange(256) / 250.0)
+        name = f"{DATE}_{i:02d}0000.npz"
+        save_section_npz(os.path.join(day, name), sec)
+        keys.append(name)
+    return str(root), keys
+
+
+def _capturing_compute(store):
+    """Deterministic stand-in for process_chunk that is sensitive to every
+    channel (so masked channels change the image)."""
+    def compute(section):
+        d = np.asarray(section.data)
+        img = np.outer(d.mean(axis=1), d.std(axis=1) + 1.0)
+        store.append(img)
+        return 1, img
+    return compute
+
+def _run(root, store, out=None, runtime=None, health_on=True):
+    cfg = PipelineConfig()
+    if health_on:
+        cfg = cfg.replace(health=HealthConfig(enabled=True))
+    ds = DirectoryDataset(DATE, root=root, ch1=None, ch2=None,
+                          smoothing=False, rescale_after=None)
+    return run_directory(ds, cfg, out_dir=out,
+                         compute_fn=_capturing_compute(store),
+                         runtime=runtime or RuntimeConfig(
+                             max_retries=1, retry_backoff_s=0.0))
+
+
+@pytest.mark.chaos
+def test_chaos_campaign_plan_exact_counts_and_bit_identity(tmp_path):
+    """The ISSUE 7 acceptance test: a seeded fault plan injecting
+    ``N_LOADER`` loader faults + ``N_CORRUPT`` corrupt-channel chunks; the
+    run completes, ``quarantined + degraded`` counts equal the plan, obs
+    counters and flight events record every transition, and every
+    unaffected chunk's contribution is bit-identical to a fault-free run."""
+    root, keys = _write_dir(tmp_path / "data")
+    plan = FaultPlan.sample(5, keys, n_loader_faults=N_LOADER,
+                            n_corrupt=N_CORRUPT, corrupt_fraction=0.2)
+    loader_keys = sorted(next(s.keys for s in plan.specs
+                              if s.site == "io.read"))
+    corrupt_keys = sorted(next(s.keys for s in plan.specs
+                               if s.site == "io.corrupt"))
+
+    # fault-free baseline, health sentinel ON (same config as the campaign)
+    base_imgs = []
+    base = _run(root, base_imgs)
+    assert base.n_chunks == N_FILES and not base.quarantined
+    assert base.n_degraded == 0                   # clean data: no masking
+    by_key_base = dict(zip(keys, base_imgs))
+
+    # --- the campaign ------------------------------------------------------
+    reg = default_registry()
+    before = {
+        "quar": _counter_value(reg, "das_runtime_chunks_total",
+                               status="quarantined"),
+        "deg": _counter_value(reg, "das_health_degraded_chunks_total"),
+        "f_read": _counter_value(reg, "das_faults_injected_total",
+                                 site="io.read", kind="error"),
+        "f_nan": _counter_value(reg, "das_faults_injected_total",
+                                site="io.corrupt", kind="nan"),
+    }
+    out = str(tmp_path / "res")
+    flight_dir = str(tmp_path / "flight")
+    inj_flight = FlightRecorder(capacity=64)
+    camp_imgs = []
+    from das_diff_veh_tpu.config import ObsConfig
+    runtime = RuntimeConfig(max_retries=1, retry_backoff_s=0.0,
+                            obs=ObsConfig(flight_dir=flight_dir))
+    with faults.injected(plan, flight=inj_flight) as inj:
+        res = _run(root, camp_imgs, out=out, runtime=runtime)
+
+    # the run completes; quarantined + degraded == the plan, exactly
+    assert res.complete
+    assert sorted(q.key for q in res.quarantined) == loader_keys
+    assert res.n_degraded == N_CORRUPT
+    assert res.n_chunks == N_FILES - N_LOADER
+
+    # obs counters recorded every transition (deltas over the campaign)
+    assert _counter_value(reg, "das_runtime_chunks_total",
+                          status="quarantined") - before["quar"] == N_LOADER
+    assert _counter_value(reg, "das_health_degraded_chunks_total") \
+        - before["deg"] == N_CORRUPT
+    # io.read refires on the retry (1 + max_retries per key, deterministic)
+    assert _counter_value(reg, "das_faults_injected_total", site="io.read",
+                          kind="error") - before["f_read"] == 2 * N_LOADER
+    assert _counter_value(reg, "das_faults_injected_total", site="io.corrupt",
+                          kind="nan") - before["f_nan"] == N_CORRUPT
+    assert inj.n_injected == 2 * N_LOADER + N_CORRUPT
+    fault_recs = [r for r in inj_flight.records() if r["kind"] == "fault"]
+    assert len(fault_recs) == inj.n_injected
+
+    # flight-recorder artifacts: the quarantine dump names the bad chunk and
+    # the ring carries the degraded-chunk health events
+    dumps = [os.path.join(flight_dir, f) for f in os.listdir(flight_dir)
+             if "quarantine" in f]
+    assert dumps
+    payload = load_flight_dump(dumps[0])
+    kinds = {r["kind"] for r in payload["records"]}
+    assert "chunk" in kinds and "run" in kinds
+    health_recs = [r for r in payload["records"] if r["kind"] == "health"]
+    assert {r["key"] for r in health_recs} <= set(corrupt_keys)
+
+    # bit-identity: every unaffected chunk's image equals the baseline's;
+    # every corrupt chunk's image differs (its channels were masked)
+    computed_keys = [k for k in keys if k not in loader_keys]
+    assert len(camp_imgs) == len(computed_keys)
+    by_key_camp = dict(zip(computed_keys, camp_imgs))
+    for k in computed_keys:
+        if k in corrupt_keys:
+            assert not np.array_equal(by_key_camp[k], by_key_base[k])
+        else:
+            np.testing.assert_array_equal(by_key_camp[k], by_key_base[k])
+
+    # manifest persisted both kinds of badness
+    from das_diff_veh_tpu.runtime import RunManifest
+    man = RunManifest.load(os.path.join(out, f"{DATE}_manifest.json"))
+    assert sorted(man.quarantined) == loader_keys
+    assert sorted(man.degraded) == corrupt_keys
+    assert man.degraded[corrupt_keys[0]]["health"]["n_masked"] >= 1
+
+
+@pytest.mark.chaos
+def test_chaos_restart_skips_known_bad_then_requeues_on_demand(tmp_path):
+    """Satellite: a restart skips manifest-quarantined chunks without
+    re-failing them through the retry ladder; ``retry_quarantined=True``
+    requeues them, and once the fault is gone they complete and fold into
+    the accumulator in deterministic order."""
+    root, keys = _write_dir(tmp_path / "data")
+    plan = FaultPlan.sample(5, keys, n_loader_faults=N_LOADER,
+                            n_corrupt=N_CORRUPT, corrupt_fraction=0.2)
+    loader_keys = sorted(next(s.keys for s in plan.specs
+                              if s.site == "io.read"))
+    out = str(tmp_path / "res")
+    camp_imgs = []
+    runtime = RuntimeConfig(max_retries=1, retry_backoff_s=0.0)
+    with faults.injected(plan, registry=MetricsRegistry()):
+        res1 = _run(root, camp_imgs, out=out, runtime=runtime)
+        assert sorted(q.key for q in res1.quarantined) == loader_keys
+
+        # restart with the fault STILL present: nothing is re-attempted —
+        # known-bad chunks are settled, the retry ladder never runs
+        imgs2 = []
+        res2 = _run(root, imgs2, out=out, runtime=runtime)
+        assert imgs2 == [] and res2.n_resumed == N_FILES
+        assert res2.resumed_quarantined == loader_keys
+        assert not res2.quarantined and res2.complete
+
+    # fault fixed + retry_quarantined: ONLY the known-bad chunks rerun
+    imgs3 = []
+    res3 = _run(root, imgs3, out=out,
+                runtime=RuntimeConfig(max_retries=1, retry_backoff_s=0.0,
+                                      retry_quarantined=True))
+    assert res3.n_requeued == N_LOADER and len(imgs3) == N_LOADER
+    assert not res3.quarantined and res3.complete
+    assert res3.n_chunks == N_FILES
+    # accumulator extends the interrupted sum in sorted-key order
+    expected = res1.avg_image.copy()
+    fresh = dict(zip(loader_keys, imgs3))
+    for k in loader_keys:
+        expected = expected + fresh[k]
+    np.testing.assert_array_equal(res3.avg_image, expected)
+
+
+@pytest.mark.chaos
+def test_chaos_poisoned_chunk_quarantined_not_averaged(tmp_path):
+    """A chunk corrupted beyond max_masked_fraction is quarantined by the
+    poison verdict (stage 'compute'), not silently averaged."""
+    root, keys = _write_dir(tmp_path / "data")
+    plan = FaultPlan(specs=(FaultSpec("io.corrupt", "nan", keys=(keys[2],),
+                                      param=0.9),), seed=1)
+    imgs = []
+    with faults.injected(plan, registry=MetricsRegistry()):
+        res = _run(root, imgs, runtime=RuntimeConfig(max_retries=0))
+    assert [q.key for q in res.quarantined] == [keys[2]]
+    assert "Poisoned" in res.quarantined[0].error
+    assert res.n_degraded == 0 and res.n_chunks == N_FILES - 1
+    assert len(imgs) == N_FILES - 1
+
+
+def test_serve_dispatch_fault_fails_one_request_not_the_cohort():
+    """An injected dispatch failure on the serve dispatcher thread fails
+    exactly the targeted request; the rest of the microbatch completes."""
+    from das_diff_veh_tpu.config import ServeConfig
+    from das_diff_veh_tpu.serve import FnComputeFactory, ServingEngine
+
+    def build(bucket):
+        def fn(section, valid, state):
+            return float(np.asarray(section.data).sum()), state
+        return fn
+
+    plan = FaultPlan(specs=(FaultSpec("serve.dispatch", "error",
+                                      keys=("1",)),))   # second dispatch
+    eng = ServingEngine(FnComputeFactory(build, "t"),
+                        ServeConfig(buckets=((4, 16),), warmup=False,
+                                    default_deadline_ms=600000.0)).start()
+    sec = DasSection(np.ones((4, 16), np.float32), np.arange(4.0),
+                     np.arange(16.0) / 250.0)
+    try:
+        with faults.injected(plan, registry=MetricsRegistry()):
+            futures = [eng.submit(sec) for _ in range(3)]
+            results = []
+            for f in futures:
+                try:
+                    results.append(f.result(timeout=30))
+                except InjectedFault:
+                    results.append("failed")
+        assert results.count("failed") == 1
+        assert results.count(64.0) == 2
+        assert eng.metrics()["errors"] == 1
+    finally:
+        eng.close()

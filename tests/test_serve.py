@@ -17,13 +17,14 @@ import urllib.request
 import numpy as np
 import pytest
 
-from das_diff_veh_tpu.config import PipelineConfig, ServeConfig
+from das_diff_veh_tpu.config import HealthConfig, PipelineConfig, ServeConfig
 from das_diff_veh_tpu.core.section import DasSection
 from das_diff_veh_tpu.runtime import load_trace, make_tracer
 from das_diff_veh_tpu.serve import (DeadlineExceededError, EngineClosedError,
                                     FnComputeFactory, ImagingComputeFactory,
                                     InvalidRequestError, NoBucketError,
-                                    QueueFullError, ServingEngine,
+                                    PoisonInputError, QueueFullError,
+                                    ServingEngine, ShutdownError,
                                     normalize_buckets, pad_section,
                                     pick_bucket, serve_in_thread)
 
@@ -545,3 +546,132 @@ def test_real_imaging_engine_bit_exact(pipeline_scene, pipeline_cfg,
     assert state["n_segments"] == 1
     assert state["n_windows"] == res.n_windows
     assert np.array_equal(state["avg_image"], res.image)
+
+
+# --------------------------------------------------------------------------
+# robustness (ISSUE 7): wedged-close ShutdownError, poison admission, 422
+# --------------------------------------------------------------------------
+
+def test_close_with_wedged_dispatcher_fails_pending_futures():
+    """close() on an engine whose dispatcher is stuck in a long compute must
+    not leave queued requests hanging forever on .result(): they fail with
+    ShutdownError; the in-flight request stays with the dispatcher."""
+    gate = _Gate()
+    eng = ServingEngine(FnComputeFactory(gate.build, "gated"),
+                        ServeConfig(buckets=((8, 32),), max_batch=1,
+                                    batch_window_ms=0.0, warmup=False,
+                                    default_deadline_ms=600000.0)).start()
+    f_wedged = eng.submit(_section(8, 32))
+    assert gate.started.wait(timeout=10.0)     # dispatcher is now inside compute
+    f_queued = eng.submit(_section(8, 32, value=2.0))
+    eng.close(timeout=0.2)                     # dispatcher cannot exit in time
+    with pytest.raises(ShutdownError):
+        f_queued.result(timeout=5.0)
+    assert isinstance(ShutdownError("x"), EngineClosedError)  # catchable as before
+    gate.release.set()                         # unwedge: in-flight one completes
+    assert f_wedged.result(timeout=10.0) == float(
+        np.asarray(_section(8, 32).data).sum())
+
+
+def test_close_with_wedged_dispatcher_fails_batch_tail():
+    """max_batch > 1: members dequeued into the dispatcher's current batch
+    (in neither the queue nor the stash) must also fail with ShutdownError
+    on a wedged close — and the dispatcher skips their dead futures when it
+    unwedges instead of computing for nobody."""
+    gate = _Gate()
+    eng = ServingEngine(FnComputeFactory(gate.build, "gated"),
+                        ServeConfig(buckets=((8, 32),), max_batch=4,
+                                    batch_window_ms=1000.0, warmup=False,
+                                    default_deadline_ms=600000.0)).start()
+    # both submitted inside the 1 s linger window: the dispatcher forms the
+    # batch [wedged, tail] BEFORE compute starts, so once compute wedges the
+    # tail request lives in the batch backlog — neither queue nor stash
+    f_wedged = eng.submit(_section(8, 32))
+    f_tail = eng.submit(_section(8, 32, value=3.0))
+    assert gate.started.wait(timeout=10.0)
+    assert eng._queue.qsize() == 0 and not eng._stash  # both were dequeued
+    eng.close(timeout=0.2)
+    with pytest.raises(ShutdownError):
+        f_tail.result(timeout=5.0)
+    gate.release.set()
+    assert f_wedged.result(timeout=10.0) == float(
+        np.asarray(_section(8, 32).data).sum())
+    # the tail request was skipped, not computed: exactly one compute ran
+    assert eng.metrics()["completed"] == 1
+
+
+def _poison_engine(**hkw):
+    cfg = ServeConfig(buckets=((8, 32),),
+                      health=HealthConfig(enabled=True, **hkw))
+    return ServingEngine(FnComputeFactory(_sum_build, "test"), cfg).start()
+
+
+def _noisy_section(nch=8, nt=32, seed=0):
+    """Non-constant data: the flatline rule (rightly) flags a constant
+    channel as dead, so health tests need live-looking traces."""
+    sec = _section(nch, nt)
+    sec.data[:] = np.random.default_rng(seed).standard_normal(
+        (nch, nt)).astype(np.float32)
+    return sec
+
+
+def test_poison_request_shed_at_admission_protects_cohort():
+    """A NaN-laden request is shed pre-queue (PoisonInputError with the
+    structured report) and never reaches the dispatcher; healthy requests
+    around it complete normally — the microbatch cohort is protected."""
+    eng = _poison_engine()
+    try:
+        good1 = _noisy_section(seed=1)
+        ok1 = eng.submit(good1)
+        bad = _noisy_section(seed=2)
+        bad.data[3, 5:20] = np.nan
+        with pytest.raises(PoisonInputError) as exc:
+            eng.submit(bad)
+        assert exc.value.health.nan_fraction > 0
+        assert exc.value.health.n_masked >= 1
+        good2 = _noisy_section(seed=3)
+        ok2 = eng.submit(good2)
+        assert ok1.result(timeout=10)["sum"] == float(good1.data.sum())
+        assert ok2.result(timeout=10)["sum"] == float(good2.data.sum())
+        snap = eng.metrics()
+        assert snap["shed_poison"] == 1 and snap["completed"] == 2
+        assert snap["errors"] == 0
+    finally:
+        eng.close()
+
+
+def test_poison_screen_disabled_by_default_admits_nan():
+    """Without ServeConfig.health the engine behaves exactly as before:
+    admission does not inspect sample values (zero-overhead default)."""
+    eng = _engine(buckets=((8, 32),))
+    try:
+        bad = _section(8, 32)
+        bad.data[0, 0] = np.nan
+        res = eng.submit(bad).result(timeout=10)   # stub compute tolerates it
+        assert np.isnan(res["sum"])
+    finally:
+        eng.close()
+
+
+def test_http_poison_maps_to_422_with_structured_body():
+    eng = _poison_engine()
+    server, _ = serve_in_thread(eng)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        data = np.asarray(_noisy_section(seed=4).data, dtype=np.float64)
+        data[2, :8] = np.nan                       # JSON null -> NaN
+        code, body = _post(base, "/v1/process",
+                           {"data": [[None if not np.isfinite(v) else v
+                                      for v in row] for row in data.tolist()]})
+        assert code == 422
+        assert set(body) == {"error", "nan_fraction", "dead_channels"}
+        assert body["nan_fraction"] > 0 and body["dead_channels"] >= 1
+        # healthy request on the same engine still serves
+        good = _noisy_section(seed=5)
+        code, body = _post(base, "/v1/process", {"data": good.data.tolist()})
+        assert code == 200
+        assert body["result"]["sum"] == pytest.approx(float(good.data.sum()))
+    finally:
+        server.shutdown()
+        server.server_close()
+        eng.close()
