@@ -468,6 +468,24 @@ class PipelineConfig:
     health: HealthConfig = field(default_factory=HealthConfig)
     max_windows: int = 64             # static per-chunk window capacity
 
+    chunk_pipeline: str = "staged"
+    """Execution mode of the per-chunk pipeline (``pipeline.timelapse``).
+    ``"staged"`` (default): every stage is an explicit eager call with host
+    geometry resolved between stages — the parity oracle, and the only mode
+    whose intermediate pytrees are individually inspectable.  ``"fused"``:
+    the whole post-screen pipeline (preprocess -> track -> window select ->
+    gather/stack -> dispersion image) runs as ONE jitted, buffer-donated XLA
+    program per chunk (``pipeline.fused.fused_process_chunk``): all slice
+    geometry is hoisted to trace time from the host ``(x, t, cfg)``
+    metadata, ``n_windows`` stays a device scalar, and the result pytree is
+    pulled in a single ``jax.device_get`` by the consumer.  One dispatch per
+    chunk instead of one per stage — on the tunneled test rig each avoided
+    dispatch is a ~100-200 ms round trip (docs/PERF.md).  Execution knob,
+    not physics: fused/staged parity is pinned bit-exact on the default
+    config by tests/test_fused_pipeline.py.  The knob participates in the
+    runtime config hash, so resumed runs and serve bucket caches never mix
+    modes silently."""
+
     def replace(self, **kw) -> "PipelineConfig":
         return dataclasses.replace(self, **kw)
 

@@ -243,6 +243,18 @@ def upsample_tracks(veh_states: jnp.ndarray, factor: int, n_out: int) -> jnp.nda
     return jax.vmap(one)(veh_states)
 
 
+def track_grid(x_axis, start_x: float, end_x: float) -> np.ndarray:
+    """Host copy of the [start_x, end_x]-restricted tracking x grid —
+    exactly the axis :func:`track_section` returns as ``VehicleTracks.x``.
+    Split out so callers that already hold the host metadata (the fused
+    single-dispatch chunk program) can resolve downstream slice geometry
+    without pulling ``tracks.x`` back off the device."""
+    x_axis = np.asarray(x_axis)
+    start_x_idx = int(np.abs(start_x - x_axis).argmin())
+    end_x_idx = int(np.abs(end_x - x_axis).argmin())
+    return x_axis[start_x_idx:end_x_idx + 1]
+
+
 def track_section(data: jnp.ndarray, x_axis, t_axis, start_x: float,
                   end_x: float, cfg: TrackingConfig = TrackingConfig(),
                   qc: TrackQCConfig = TrackQCConfig()) -> VehicleTracks:
@@ -253,14 +265,12 @@ def track_section(data: jnp.ndarray, x_axis, t_axis, start_x: float,
     x_axis = np.asarray(x_axis)
     t_axis = np.asarray(t_axis)
     start_x_idx = int(np.abs(start_x - x_axis).argmin())
-    end_x_idx = int(np.abs(end_x - x_axis).argmin())
     base, base_valid = detect_vehicle_base(data, jnp.asarray(t_axis),
                                            start_x_idx, cfg)
     states, _ = track_vehicles(data, x_axis, start_x, end_x,
                                base, base_valid, cfg)
     states, keep = track_qc(states, qc)
-    n_out = end_x_idx - start_x_idx + 1
-    full = upsample_tracks(states, cfg.channel_stride, n_out)
+    grid = track_grid(x_axis, start_x, end_x)
+    full = upsample_tracks(states, cfg.channel_stride, grid.shape[0])
     return VehicleTracks(t_idx=full, valid=base_valid & keep,
-                         x=jnp.asarray(x_axis[start_x_idx:end_x_idx + 1]),
-                         t=jnp.asarray(t_axis))
+                         x=jnp.asarray(grid), t=jnp.asarray(t_axis))

@@ -65,9 +65,33 @@ def mute_along_time(data: jnp.ndarray, alpha: float = 0.3) -> jnp.ndarray:
     return data * tukey_window(data.shape[-1], alpha)[None, :]
 
 
+def window_x_bounds(x: np.ndarray, x0: float,
+                    cfg: WindowConfig = WindowConfig()) -> tuple:
+    """Host ``(start_x_idx, end_x_idx)`` of the window aperture around pivot
+    ``x0`` — the slice geometry :func:`select_windows` cuts with (end
+    exclusive, reference apis/data_classes.py:212).  Split out so the fused
+    chunk program (and the VSG geometry builder feeding on ``batch.x``) can
+    resolve the aperture from host metadata without touching the device."""
+    x = np.asarray(x)
+    start_x = x0 - cfg.length_sw * cfg.spatial_ratio
+    end_x = start_x + cfg.length_sw
+    return (int(np.abs(start_x - x).argmin()),
+            int(np.abs(end_x - x).argmin()))
+
+
+def window_x_slice(x: np.ndarray, x0: float,
+                   cfg: WindowConfig = WindowConfig()) -> np.ndarray:
+    """Host copy of the ``WindowBatch.x`` axis :func:`select_windows`
+    produces for this geometry."""
+    start_x_idx, end_x_idx = window_x_bounds(x, x0, cfg)
+    return np.asarray(x)[start_x_idx:end_x_idx]
+
+
 def select_windows(data: jnp.ndarray, x: np.ndarray, t: np.ndarray,
                    tracks: VehicleTracks, x0: float,
-                   cfg: WindowConfig = WindowConfig()) -> WindowBatch:
+                   cfg: WindowConfig = WindowConfig(), *,
+                   track_x: np.ndarray = None,
+                   track_t: np.ndarray = None) -> WindowBatch:
     """Cut one static-shape window batch around each tracked vehicle's arrival
     at pivot ``x0`` (reference SurfaceWaveSelector.locate_windows,
     apis/data_classes.py:170-223).
@@ -83,25 +107,26 @@ def select_windows(data: jnp.ndarray, x: np.ndarray, t: np.ndarray,
 
     ``x``/``t`` must be concrete (host) arrays — static slice geometry is
     resolved in numpy; the per-vehicle time cuts are vmapped dynamic slices.
-    """
-    # sync in-flight device work first: the axon TPU tunnel cannot service a
-    # device->host read (the np.asarray geometry below) while compute is in
-    # flight, and the failure poisons the stream
-    jax.block_until_ready(data)
+    ``data`` may be a tracer (the fused chunk program calls this inside
+    jit); pass ``track_x``/``track_t`` (host copies of ``tracks.x``/
+    ``tracks.t``, e.g. from ``models.tracking.track_grid``) in that case so
+    the tracking-grid geometry below never reads the device."""
+    if not isinstance(data, jax.core.Tracer):
+        # sync in-flight device work first: the axon TPU tunnel cannot
+        # service a device->host read (the np.asarray geometry below) while
+        # compute is in flight, and the failure poisons the stream
+        jax.block_until_ready(data)
     x = np.asarray(x)
     t = np.asarray(t)
     dt = float(t[1] - t[0])
     win_nsamp = int(cfg.wlen_sw / dt)
     spacing = cfg.temporal_spacing if cfg.temporal_spacing else cfg.wlen_sw
 
-    start_x = x0 - cfg.length_sw * cfg.spatial_ratio
-    end_x = start_x + cfg.length_sw
-    start_x_idx = int(np.abs(start_x - x).argmin())
-    end_x_idx = int(np.abs(end_x - x).argmin())          # exclusive (reference :212)
+    start_x_idx, end_x_idx = window_x_bounds(x, x0, cfg)
     nx = end_x_idx - start_x_idx
 
-    x_track = np.asarray(tracks.x)
-    t_track = np.asarray(tracks.t)
+    x_track = np.asarray(tracks.x if track_x is None else track_x)
+    t_track = np.asarray(tracks.t if track_t is None else track_t)
     x0_track_idx = int(np.abs(x_track - x0).argmin())
     dt_track = float(t_track[1] - t_track[0])
     t_track0 = float(t_track[0])

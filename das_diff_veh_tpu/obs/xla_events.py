@@ -42,6 +42,14 @@ from das_diff_veh_tpu.obs.registry import MetricsRegistry
 _TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
+# Emitted by ``pipeline.fused.fused_process_chunk`` at its single program
+# launch site — one event per fused chunk dispatch, by construction the
+# only device dispatch the fused path performs.  Flows through the same
+# listener as the trace/compile events, so "one dispatch per chunk AND
+# zero steady-state retraces" is assertable from one registry scrape
+# (``CompileWatch.fused_dispatches`` vs ``CompileWatch.traces``).
+FUSED_DISPATCH_EVENT = "/das/pipeline/fused_chunk_dispatch"
+
 _lock = threading.Lock()
 # registry -> subscription count.  Ref-counted because independent
 # components legitimately share one registry (the serve CLI's engine and
@@ -109,6 +117,18 @@ class CompileWatch:
     @property
     def compile_seconds(self) -> float:
         return self._value("das_jax_compile_seconds_total")
+
+    @property
+    def fused_dispatches(self) -> int:
+        """Fused per-chunk program launches (:data:`FUSED_DISPATCH_EVENT`
+        events) counted into this registry."""
+        fam = self._registry.get("das_jax_events_total")
+        if fam is None:
+            return 0
+        for values, child in fam.children():
+            if values == (FUSED_DISPATCH_EVENT,):
+                return int(child.value)
+        return 0
 
 
 def install(registry: MetricsRegistry) -> CompileWatch:

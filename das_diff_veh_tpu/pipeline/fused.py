@@ -1,0 +1,170 @@
+"""Single-dispatch fused per-chunk pipeline: ONE donated XLA program from
+tracking to dispersion image.
+
+The staged path (``pipeline.timelapse.process_chunk``) interleaves host
+geometry with eager device stages — on the tunneled single-chip test rig
+every stage boundary is a ~100-200 ms round trip (docs/PERF.md), and
+``BENCH_cpu_smoke_r11.json`` measured the SAME work at 0.256 s amortized
+in-dispatch vs 0.828 s when dispatch-bound: the latency lever is dispatch
+*count*, not kernel time.  This module runs the whole post-screen pipeline
+as one jitted program per chunk:
+
+- **all geometry at trace time**: every slice bound (tracking grid, window
+  aperture, VSG geometry, dispersion offsets) resolves from the host
+  ``(x, t, cfg)`` metadata while tracing, so the compiled program contains
+  only device ops — ``chunk_body`` is shared with the staged path, which
+  stays the parity oracle (bit-exact, tests/test_fused_pipeline.py);
+- **on-device masking end to end**: ``batch.valid`` never becomes a Python
+  int mid-pipeline; ``n_windows`` returns as a device scalar inside the
+  result pytree, pulled by the consumer in one ``jax.device_get``;
+- **buffer donation**: the chunk input is donated to the program
+  (``donate_argnums``), so the dominant buffer is reused instead of held
+  across the dispatch.  The fused entry therefore CONSUMES a device-array
+  input — callers that need ``section.data`` afterwards should pass host
+  numpy (the entry stages a fresh device buffer) or copy first.  The
+  runtime's loader device_puts a fresh buffer per chunk, so the batch and
+  serve paths donate safely by construction;
+- **per-geometry program cache**: programs are keyed on the data
+  shape/dtype, fingerprints of the ``x``/``t`` axes, the config, method,
+  and ``with_qs`` — the serve layer's per-bucket warmup therefore compiles
+  each bucket's fused program once, and steady state is zero compiles
+  (asserted via ``obs/xla_events.py`` trace counters).
+
+Device-truth accounting mirrors PR 7's zero-extra-dispatch pattern
+(``resilience.health.SCREENS_BY_TAG``): the single launch site below
+counts per-tag module counters AND emits a ``jax.monitoring`` event
+(``obs.xla_events.FUSED_DISPATCH_EVENT``) that lands in any installed
+metrics registry next to the trace/compile counters, so "one dispatch per
+chunk, zero steady-state retraces" is a counter assertion, not a claim.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from das_diff_veh_tpu.config import PipelineConfig
+from das_diff_veh_tpu.core.section import DasSection
+from das_diff_veh_tpu.pipeline.timelapse import (ChunkResult, chunk_body,
+                                                 resolve_chunk_metadata,
+                                                 screen_chunk)
+
+_lock = threading.Lock()
+_PROGRAMS: Dict[tuple, object] = {}
+
+# per-call-site dispatch accounting (the PR 7 SCREENS_BY_TAG pattern):
+# tests assert "exactly one device dispatch per fused chunk" against these
+# instead of trusting the docstring
+DISPATCHES_BY_TAG: Dict[str, int] = {}
+
+
+def n_dispatches(tag: Optional[str] = None) -> int:
+    with _lock:
+        if tag is not None:
+            return DISPATCHES_BY_TAG.get(tag, 0)
+        return sum(DISPATCHES_BY_TAG.values())
+
+
+def n_programs() -> int:
+    """Distinct fused programs built in this process (cache size)."""
+    with _lock:
+        return len(_PROGRAMS)
+
+
+def clear_programs() -> None:
+    """Drop the program cache (tests; a donated-buffer program pins its
+    input layout, so geometry churn in a long session can release here)."""
+    with _lock:
+        _PROGRAMS.clear()
+
+
+def _fingerprint(a: np.ndarray) -> tuple:
+    a = np.ascontiguousarray(a)
+    return (a.shape, str(a.dtype), hashlib.sha1(a.tobytes()).hexdigest())
+
+
+def _donate() -> tuple:
+    # XLA CPU cannot alias the input record into this program's outputs and
+    # warns per-compile about the unusable donation; donation buys its
+    # memory back on the accelerator backends only
+    return (0,) if jax.default_backend() != "cpu" else ()
+
+
+def _program(shape: tuple, dtype, x_dist: np.ndarray, t: np.ndarray,
+             cfg: PipelineConfig, method: str, with_qs: bool):
+    """Get-or-build the fused program for this chunk geometry.  The key
+    hashes the axis VALUES (not just shapes): every slice bound inside is a
+    trace-time constant derived from them, so two sections that differ only
+    in (say) the time origin are different programs — exactly the serve
+    layer's bucket+canonicalization contract (serve/imaging.py rebases t,
+    so real deployments hit one key per bucket)."""
+    key = (tuple(shape), str(dtype), _fingerprint(x_dist), _fingerprint(t),
+           cfg, method, with_qs)
+    with _lock:
+        prog = _PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+
+    dt = float(t[1] - t[0])
+
+    def body(data):
+        img, vsg_stack, n_windows, tracks, batch, qs_batch = chunk_body(
+            data, x_dist, t, dt, cfg, method=method, with_qs=with_qs)
+        return dict(disp_image=img, vsg_stack=vsg_stack,
+                    n_windows=n_windows, tracks=tracks, batch=batch,
+                    qs_batch=qs_batch)
+
+    prog = jax.jit(body, donate_argnums=_donate())
+    with _lock:
+        # setdefault: a racing builder's program is identical — keep one
+        return _PROGRAMS.setdefault(key, prog)
+
+
+def fused_process_chunk(section: DasSection,
+                        cfg: Optional[PipelineConfig] = None,
+                        method: str = "xcorr", x_is_channels: bool = False,
+                        with_qs: bool = False,
+                        tag: str = "process_chunk") -> ChunkResult:
+    """``process_chunk`` semantics in one device dispatch.
+
+    After the input-health screen (the one unavoidable host decision — its
+    verdict gates a Python ``raise``), the remaining pipeline executes as a
+    single jitted, input-donated XLA program; the returned
+    :class:`ChunkResult` is an inert on-device pytree whose ``n_windows``
+    is a device scalar.  Pull what you need in ONE ``jax.device_get`` —
+    ``run_directory`` and the serve compute do exactly that.
+
+    Bit-exact vs the staged oracle on the default config (both methods,
+    tests/test_fused_pipeline.py); reach it via
+    ``cfg.replace(chunk_pipeline="fused")`` on any ``process_chunk``
+    call site, or call this entry directly.
+    """
+    assert method in {"xcorr", "surface_wave"}
+    cfg = cfg if cfg is not None else PipelineConfig()
+
+    section, health = screen_chunk(section, cfg, tag=tag)
+    x_dist, t, _dt = resolve_chunk_metadata(section, cfg, x_is_channels)
+
+    data = section.data
+    shape, dtype = data.shape, data.dtype
+    prog = _program(shape, dtype, x_dist, t, cfg, method, with_qs)
+    if not isinstance(data, jax.Array):
+        # host input: stage a fresh device buffer the program may consume
+        data = jnp.asarray(data)
+
+    with _lock:
+        DISPATCHES_BY_TAG[tag] = DISPATCHES_BY_TAG.get(tag, 0) + 1
+    from das_diff_veh_tpu.obs.xla_events import FUSED_DISPATCH_EVENT
+    jax.monitoring.record_event(FUSED_DISPATCH_EVENT)
+    out = prog(data)
+
+    return ChunkResult(disp_image=out["disp_image"],
+                       vsg_stack=out["vsg_stack"],
+                       n_windows=out["n_windows"], tracks=out["tracks"],
+                       batch=out["batch"], qs_batch=out["qs_batch"],
+                       health=health)

@@ -75,6 +75,8 @@ def preprocess_for_tracking(data: jnp.ndarray, x_dist: np.ndarray, dt: float,
     # spatial resample dx -> target_dx (8.16 m -> 1 m is 204/25)
     frac = Fraction(dx / cfg.target_dx).limit_denominator(1000)
     out = resample_poly(out, frac.numerator, frac.denominator, axis=0)
-    x_track = np.arange(out.shape[0]) * cfg.target_dx + float(np.asarray(x_dist)[0])
+    # index BEFORE converting: np.asarray(x_dist)[0] would pull the whole
+    # axis device->host when x_dist is device-resident, for one scalar
+    x_track = np.arange(out.shape[0]) * cfg.target_dx + float(np.asarray(x_dist[0]))
     out = bandpass_space(out, cfg.target_dx, cfg.flo_space, cfg.fhi_space)
     return out, x_track, cfg.subsample

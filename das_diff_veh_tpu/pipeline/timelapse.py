@@ -19,8 +19,9 @@ from das_diff_veh_tpu.config import PipelineConfig
 from das_diff_veh_tpu.core.section import (DasSection, VehicleTracks,
                                            WindowBatch)
 from das_diff_veh_tpu.models import vsg as V
-from das_diff_veh_tpu.models.tracking import track_section
-from das_diff_veh_tpu.models.windows import select_windows, traj_mute_mask
+from das_diff_veh_tpu.models.tracking import track_grid, track_section
+from das_diff_veh_tpu.models.windows import (select_windows, traj_mute_mask,
+                                             window_x_slice)
 from das_diff_veh_tpu.pipeline.preprocess import (channels_to_distance,
                                                   preprocess_for_surface_waves,
                                                   preprocess_for_tracking)
@@ -32,7 +33,11 @@ class ChunkResult:
 
     disp_image: jnp.ndarray          # (nvel, nfreq)
     vsg_stack: Optional[jnp.ndarray]  # (nch_out, wlen) for method='xcorr'
-    n_windows: int                   # accepted (isolated) vehicle windows
+    n_windows: int                   # accepted (isolated) vehicle windows —
+                                     # a Python int on the staged path, a
+                                     # device scalar on the fused path (pull
+                                     # it in the SAME jax.device_get as the
+                                     # image; that is the point)
     tracks: VehicleTracks
     batch: WindowBatch               # surface-wave-band windows
     qs_batch: Optional[WindowBatch]  # raw-band windows (with_qs=True only)
@@ -40,22 +45,29 @@ class ChunkResult:
                                      # cfg.health.enabled, else None
 
 
-def disp_image_batch(batch: WindowBatch, cfg: PipelineConfig) -> jnp.ndarray:
+def disp_image_batch(batch: WindowBatch, cfg: PipelineConfig,
+                     x: Optional[np.ndarray] = None,
+                     dt: Optional[float] = None) -> jnp.ndarray:
     """Direct per-window dispersion images with muting (reference
     DispersionImagesFromWindows + SurfaceWaveDispersion 'naive' over
     [disp_start_x+x0, x0], apis/imaging_classes.py:96-107 +
     apis/dispersion_classes.py:24-32): mute along the trajectory, slant the
     muted window over the imaging offset range.  Returns (max_windows, nvel,
-    nfreq)."""
+    nfreq).
+
+    ``x``/``dt``: host copies of the batch's window x axis and sample
+    interval.  When omitted they are pulled from ``batch`` (a device->host
+    sync); the fused chunk program passes them so the slice geometry below
+    resolves at trace time without touching the device."""
     dcfg = cfg.dispersion
     dx = cfg.interrogator.dx
-    x = np.asarray(batch.x)
+    x = np.asarray(batch.x if x is None else x)
     start_x = cfg.imaging.x0 + cfg.imaging.disp_start_x
     sxi = int(np.argmax(x >= start_x))
     nx = int((cfg.imaging.disp_end_x - cfg.imaging.disp_start_x) / dx)
     freqs = jnp.arange(dcfg.freq_min, dcfg.freq_max, dcfg.freq_step)
     vels = jnp.arange(dcfg.vel_min, dcfg.vel_max, dcfg.vel_step)
-    dt = float(batch.t[0, 1] - batch.t[0, 0])
+    dt = float(batch.t[0, 1] - batch.t[0, 0]) if dt is None else float(dt)
 
     from das_diff_veh_tpu.ops.dispersion import fv_map_fk, fv_map_phase_shift
 
@@ -82,42 +94,33 @@ def disp_image_batch(batch: WindowBatch, cfg: PipelineConfig) -> jnp.ndarray:
     return jax.lax.map(one, args)
 
 
-def process_chunk(section: DasSection, cfg: Optional[PipelineConfig] = None,
-                  method: str = "xcorr", x_is_channels: bool = False,
-                  with_qs: bool = False) -> ChunkResult:
-    """Full per-chunk pipeline (reference TimeLapseImaging usage in
-    apis/imaging_workflow.py:50-67): preprocess both bands, track, select
-    windows around cfg.imaging.x0, and build the method's stacked image.
-
-    ``method``: 'xcorr' (virtual shot gathers -> dispersion of the stack) or
-    'surface_wave' (muted direct dispersion per window, averaged).
-    ``with_qs``: also cut raw-band windows for quasi-static weight analysis
-    (reference qs_selector, apis/timeLapseImaging.py:183-191); off by default
-    because the imaging workflow never consumes them.
-    """
-    assert method in {"xcorr", "surface_wave"}
-    cfg = cfg if cfg is not None else PipelineConfig()
-
-    # --- input-health sentinel (resilience/health.py) ------------------------
-    # Off by default: this branch costs one attribute check and ZERO extra
-    # device dispatches (counter-asserted in tests/test_resilience.py).  On,
-    # one fused jitted program screens NaN/Inf, flatline, and clipped
-    # channels and masks them before anything downstream can average them.
-    health = None
-    if cfg.health.enabled:
-        from das_diff_veh_tpu.resilience.health import (PoisonedChunkError,
-                                                        screen_section)
-        section, health = screen_section(section, cfg.health,
-                                         tag="process_chunk")
-        if not health.ok(cfg.health):
-            raise PoisonedChunkError(health)
-
-    x_dist = (channels_to_distance(section.x, cfg.interrogator)
+def resolve_chunk_metadata(section: DasSection, cfg: PipelineConfig,
+                           x_is_channels: bool = False):
+    """The one host decision of the per-chunk path: ``(x_dist, t, dt)`` as
+    host numpy from the section's axis metadata.  Loaders keep ``x``/``t``
+    host-resident (only ``data`` rides the device, runtime/executor.py), so
+    this is normally a no-op view; a device-resident axis is pulled ONCE
+    here and never again downstream."""
+    x_dist = (channels_to_distance(np.asarray(section.x), cfg.interrogator)
               if x_is_channels else np.asarray(section.x))
     t = np.asarray(section.t)
-    dt = float(t[1] - t[0])
-    data = jnp.asarray(section.data)
+    return x_dist, t, float(t[1] - t[0])
 
+
+def chunk_body(data: jnp.ndarray, x_dist: np.ndarray, t: np.ndarray,
+               dt: float, cfg: PipelineConfig, method: str = "xcorr",
+               with_qs: bool = False):
+    """The traceable per-chunk pipeline core shared by the staged and fused
+    paths: preprocess both bands -> track -> select windows -> build the
+    method's stacked image.  ``x_dist``/``t`` MUST be host numpy — every
+    slice bound below resolves from them at trace time, so ``data`` may be
+    a tracer and the whole body compiles into one XLA program with zero
+    host round trips (pinned by tests/test_fused_pipeline.py).
+
+    Returns ``(img, vsg_stack, n_windows, tracks, batch, qs_batch)`` with
+    ``n_windows`` a device scalar (the staged wrapper converts it; the
+    fused program keeps it on-device until the caller's single
+    ``device_get``)."""
     # --- both preprocessing bands --------------------------------------------
     d_sw = preprocess_for_surface_waves(data, dt, cfg.sw_preprocess,
                                         normalize=(method == "surface_wave"))
@@ -130,30 +133,91 @@ def process_chunk(section: DasSection, cfg: Optional[PipelineConfig] = None,
     tracks = track_section(-d_track, x_track, t_track,
                            cfg.imaging.start_x, cfg.imaging.end_x,
                            cfg.tracking, cfg.track_qc)
+    # host copies of the tracking grid (== tracks.x / tracks.t values):
+    # select_windows resolves its geometry from these instead of pulling
+    # the device-resident pytree leaves back
+    tgrid = track_grid(x_track, cfg.imaging.start_x, cfg.imaging.end_x)
 
     # --- select windows: filtered band + raw band (quasi-static weights),
     #     reference select_surface_wave_windows (:166-192) ---------------------
-    batch = select_windows(d_sw, x_dist, t, tracks, cfg.imaging.x0, cfg.window)
+    batch = select_windows(d_sw, x_dist, t, tracks, cfg.imaging.x0,
+                           cfg.window, track_x=tgrid, track_t=t_track)
     qs_batch = (select_windows(data, x_dist, t, tracks, cfg.imaging.x0,
-                               cfg.window) if with_qs else None)
+                               cfg.window, track_x=tgrid, track_t=t_track)
+                if with_qs else None)
 
-    n_windows = int(jnp.sum(batch.valid))
+    n_windows = jnp.sum(batch.valid)
+    x_win = window_x_slice(x_dist, cfg.imaging.x0, cfg.window)  # host batch.x
     if method == "xcorr":
-        g = V.VsgGeometry.build(np.asarray(batch.x), dt, cfg.imaging.x0,
+        g = V.VsgGeometry.build(x_win, dt, cfg.imaging.x0,
                                 cfg.imaging.x0 + cfg.imaging.disp_start_x,
                                 cfg.imaging.x0 + cfg.gather.far_offset,
                                 cfg.gather)
         gathers = V.build_gather_batch(batch, g, cfg.gather)
         stack = V.stack_gathers(gathers, batch.valid)
-        img = V.gather_disp_image(stack, g.offsets(np.asarray(batch.x)), dt,
+        img = V.gather_disp_image(stack, g.offsets(x_win), dt,
                                   cfg.interrogator.dx, cfg.dispersion,
                                   cfg.imaging.disp_start_x, cfg.imaging.disp_end_x)
         vsg_stack = stack
     else:
-        imgs = disp_image_batch(batch, cfg)
+        imgs = disp_image_batch(batch, cfg, x=x_win, dt=dt)
         img = V.stack_gathers(imgs, batch.valid)
         vsg_stack = None
+    return img, vsg_stack, n_windows, tracks, batch, qs_batch
+
+
+def screen_chunk(section: DasSection, cfg: PipelineConfig, tag: str):
+    """Input-health sentinel shared by the staged and fused entries
+    (resilience/health.py).  Off by default: costs one attribute check and
+    ZERO extra device dispatches (counter-asserted in
+    tests/test_resilience.py).  On, one fused jitted program screens
+    NaN/Inf, flatline, and clipped channels and masks them before anything
+    downstream can average them.  Returns ``(section, health-or-None)``;
+    raises ``PoisonedChunkError`` on a failing verdict."""
+    if not cfg.health.enabled:
+        return section, None
+    from das_diff_veh_tpu.resilience.health import (PoisonedChunkError,
+                                                    screen_section)
+    section, health = screen_section(section, cfg.health, tag=tag)
+    if not health.ok(cfg.health):
+        raise PoisonedChunkError(health)
+    return section, health
+
+
+def process_chunk(section: DasSection, cfg: Optional[PipelineConfig] = None,
+                  method: str = "xcorr", x_is_channels: bool = False,
+                  with_qs: bool = False) -> ChunkResult:
+    """Full per-chunk pipeline (reference TimeLapseImaging usage in
+    apis/imaging_workflow.py:50-67): preprocess both bands, track, select
+    windows around cfg.imaging.x0, and build the method's stacked image.
+
+    ``method``: 'xcorr' (virtual shot gathers -> dispersion of the stack) or
+    'surface_wave' (muted direct dispersion per window, averaged).
+    ``with_qs``: also cut raw-band windows for quasi-static weight analysis
+    (reference qs_selector, apis/timeLapseImaging.py:183-191); off by default
+    because the imaging workflow never consumes them.
+
+    ``cfg.chunk_pipeline`` selects the execution mode: ``"staged"`` (this
+    body — eager stages, host geometry between them, ``n_windows`` pulled
+    to a Python int) or ``"fused"`` (``pipeline.fused.fused_process_chunk``
+    — one jitted donated program per chunk, ``n_windows`` left on-device).
+    """
+    assert method in {"xcorr", "surface_wave"}
+    cfg = cfg if cfg is not None else PipelineConfig()
+    assert cfg.chunk_pipeline in {"staged", "fused"}, cfg.chunk_pipeline
+    if cfg.chunk_pipeline == "fused":
+        from das_diff_veh_tpu.pipeline.fused import fused_process_chunk
+        return fused_process_chunk(section, cfg, method=method,
+                                   x_is_channels=x_is_channels,
+                                   with_qs=with_qs)
+
+    section, health = screen_chunk(section, cfg, tag="process_chunk")
+    x_dist, t, dt = resolve_chunk_metadata(section, cfg, x_is_channels)
+    data = jnp.asarray(section.data)
+
+    img, vsg_stack, n_windows, tracks, batch, qs_batch = chunk_body(
+        data, x_dist, t, dt, cfg, method=method, with_qs=with_qs)
 
     return ChunkResult(disp_image=img, vsg_stack=vsg_stack,
-                       n_windows=n_windows, tracks=tracks,
+                       n_windows=int(n_windows), tracks=tracks,
                        batch=batch, qs_batch=qs_batch, health=health)

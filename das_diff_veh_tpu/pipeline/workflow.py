@@ -306,10 +306,15 @@ def run_directory(dataset: DirectoryDataset, cfg: Optional[PipelineConfig] = Non
         def _default_compute(section: DasSection):
             chunk = process_chunk(section, cfg, method=method,
                                   x_is_channels=x_is_channels)
-            jax.block_until_ready(chunk.disp_image)
-            n = int(chunk.n_windows)
-            return (n, (np.asarray(chunk.disp_image) if n > 0 else None),
-                    chunk.health)
+            # ONE device_get for everything this consumer needs: the count
+            # and the image come back in a single coalesced transfer (which
+            # also blocks), instead of the old block_until_ready +
+            # per-field int()/np.asarray() pull-per-field epilogue — on the
+            # fused path n_windows is a device scalar, so a separate int()
+            # here would be a second round trip per chunk
+            n, img = jax.device_get((chunk.n_windows, chunk.disp_image))
+            n = int(n)
+            return (n, (np.asarray(img) if n > 0 else None), chunk.health)
 
         chunk_fn = compute_fn if compute_fn is not None else _default_compute
 

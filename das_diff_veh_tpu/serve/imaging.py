@@ -151,9 +151,13 @@ class ImagingComputeFactory(ComputeFactory):
                 section = DasSection(section.data, section.x, canonical_t)
             chunk = process_chunk(section, self.cfg, method=self.method,
                                   x_is_channels=self.x_is_channels)
-            jax.block_until_ready(chunk.disp_image)
-            n = int(chunk.n_windows)
-            img = np.asarray(chunk.disp_image)
+            # one coalesced pull of everything the result needs (blocks
+            # like the old block_until_ready did); with
+            # cfg.chunk_pipeline="fused" this is the fused program's single
+            # device->host transfer per request
+            n, img = jax.device_get((chunk.n_windows, chunk.disp_image))
+            n = int(n)
+            img = np.asarray(img)
             result = ImagingResult(image=img, n_windows=n,
                                    valid=tuple(valid), bucket=bucket,
                                    padded=tuple(valid) != tuple(bucket),

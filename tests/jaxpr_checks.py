@@ -80,6 +80,54 @@ def collective_eqns(closed_jaxpr, names=("all_gather", "all_to_all")):
             if e.primitive.name in names]
 
 
+# --------------------------------------------------------------------------
+# host-sync detection (PR 16): the fused per-chunk program claims "zero
+# intermediate host syncs".  Two structural checks pin it:
+#
+# 1. :func:`trace_or_host_sync` — JAX turns EVERY implicit device->host
+#    coercion of a traced value (``np.asarray``/``__array__``, ``float()``,
+#    ``int()``/``__index__``, ``bool()``) into a trace-time error, so "the
+#    region traces to a jaxpr at all" is itself the proof that no implicit
+#    pull survives inside it.  The staged path validates the detector: its
+#    ``int(n_windows)`` epilogue must raise :class:`HostSync`.
+# 2. :func:`host_sync_eqns` — the only way a *traced* program can still
+#    round-trip to the host at run time is a callback primitive (or
+#    infeed/outfeed); the fused program's jaxpr must contain none.
+# --------------------------------------------------------------------------
+
+HOST_CALLBACK_PRIMITIVES = ("pure_callback", "io_callback", "debug_callback",
+                            "debug_print", "callback", "infeed", "outfeed")
+
+
+class HostSync(Exception):
+    """The traced region synchronizes a traced value back to the host."""
+
+
+def trace_or_host_sync(fn, *args):
+    """Trace ``fn(*args)`` to a ClosedJaxpr, or raise :class:`HostSync` if
+    tracing hits an implicit device->host coercion of a traced value.
+    ``args`` may be ``jax.ShapeDtypeStruct``s — the detector never needs
+    real buffers."""
+    import jax.errors as jex
+    sync_errors = tuple(
+        getattr(jex, n) for n in
+        ("TracerArrayConversionError", "ConcretizationTypeError",
+         "TracerIntegerConversionError", "TracerBoolConversionError")
+        if hasattr(jex, n))
+    try:
+        return jax.make_jaxpr(fn)(*args)
+    except sync_errors as e:  # noqa: B030 — tuple built above
+        raise HostSync(str(e)) from e
+
+
+def host_sync_eqns(closed_jaxpr, names=HOST_CALLBACK_PRIMITIVES):
+    """Equations anywhere in the program that can round-trip to the host at
+    run time (callback/infeed/outfeed primitives).  Empty for the fused
+    chunk program — one dispatch in, one pytree out, nothing in between."""
+    return [e for e in iter_eqns(closed_jaxpr.jaxpr)
+            if e.primitive.name in names]
+
+
 def shard_body_full_set_avals(closed_jaxpr, n_full, nwin):
     """Equations *inside a shard_map body* that bind a rank-3 value shaped
     like the FULL receiver spectra set — (n_full, nwin, ...) — i.e. a

@@ -1,0 +1,209 @@
+"""Fused single-dispatch chunk pipeline (PR 16): parity vs the staged
+oracle, structural zero-host-sync pins, and dispatch accounting.
+
+Parity contract (probed on this backend, documented in docs/PERF.md):
+every *structural* field — window count, validity masks, the windowed
+data/time/trajectory tensors — is bit-exact between the staged and fused
+paths and asserted with ``assert_array_equal``.  The *continuous* outputs
+(dispersion image, VSG stack, sub-sample arrival times) are NOT bit-exact:
+the staged oracle executes one tiny XLA program per op while the fused
+path compiles the whole chunk as one program, and whole-program fusion
+reassociates float reductions at the last-ulp level (measured: 1 ulp =
+6e-8 on f32 gathers, ~4e-15 relative on the f64 image).  Those fields are
+held to a peak-relative 1e-7 oracle bar — seven orders of magnitude of
+margin over the measured divergence, and far below the physics assertions
+(ridge median error threshold 0.12) that consume the image.
+
+Compile/exec budget: the xcorr parity test runs at the canonical
+``pipeline_scene`` geometry (sharing the session fixtures' programs); the
+surface_wave parity and both degenerate-chunk tests run on ~3x cheaper
+40 s scenes (``small_scene_sw`` and ``small_scene``), whose two fused
+programs (xcorr via the echo fixture, surface_wave via the parity
+fixture) are likewise traced once per session and reused — the
+zero-vehicle test's steady-state counter pins depend on exactly that
+reuse.  The full-geometry surface_wave parity is kept under the ``slow``
+marker.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from das_diff_veh_tpu.core.section import DasSection
+from das_diff_veh_tpu.pipeline import fused as F
+from das_diff_veh_tpu.pipeline.timelapse import (chunk_body, process_chunk,
+                                                 resolve_chunk_metadata)
+
+ORACLE_BAR = 1e-7  # peak-relative; see module docstring
+
+
+def _peak_rel(got, want) -> float:
+    got, want = np.asarray(got), np.asarray(want)
+    return float(np.max(np.abs(got - want)) / np.max(np.abs(want)))
+
+
+# --------------------------------------------------------------------------
+# parity vs the staged oracle
+# --------------------------------------------------------------------------
+
+def test_fused_xcorr_parity(chunk_result_xcorr, fused_chunk_xcorr):
+    s, f = chunk_result_xcorr, fused_chunk_xcorr
+    # fused n_windows is a device scalar by design — same value once pulled
+    assert int(jax.device_get(f.n_windows)) == s.n_windows >= 1
+    assert f.qs_batch is None and s.qs_batch is None
+
+    sb, fb, st, ft = jax.device_get((s.batch, f.batch, s.tracks, f.tracks))
+    np.testing.assert_array_equal(fb.valid, sb.valid)
+    np.testing.assert_array_equal(fb.data, sb.data)
+    np.testing.assert_array_equal(fb.t, sb.t)
+    np.testing.assert_array_equal(fb.x, sb.x)
+    np.testing.assert_array_equal(fb.traj_x, sb.traj_x)
+    np.testing.assert_array_equal(fb.traj_t, sb.traj_t)
+    np.testing.assert_array_equal(ft.valid, st.valid)
+    np.testing.assert_array_equal(ft.x, st.x)
+    np.testing.assert_array_equal(ft.t, st.t)
+    # sub-sample arrival times: continuous (Kalman smoother output); the
+    # window cut quantizes them away, which is why the batch tensors above
+    # stay bit-exact.  Measured divergence 2.4e-4 absolute / 5e-8 relative.
+    np.testing.assert_allclose(ft.t_idx, st.t_idx, rtol=1e-6, atol=1e-2,
+                               equal_nan=True)
+
+    assert _peak_rel(f.vsg_stack, s.vsg_stack) < ORACLE_BAR    # meas. 2e-16
+    assert _peak_rel(f.disp_image, s.disp_image) < ORACLE_BAR  # meas. 4e-15
+
+
+def test_fused_surface_wave_parity(small_chunk_sw, fused_small_sw):
+    s, f = small_chunk_sw, fused_small_sw
+    assert int(jax.device_get(f.n_windows)) == s.n_windows >= 1
+    assert f.vsg_stack is None and s.vsg_stack is None
+    sb, fb = jax.device_get((s.batch, f.batch))
+    np.testing.assert_array_equal(fb.valid, sb.valid)
+    np.testing.assert_array_equal(fb.data, sb.data)
+    assert _peak_rel(f.disp_image, s.disp_image) < ORACLE_BAR
+
+
+@pytest.mark.slow
+def test_fused_surface_wave_parity_full(chunk_result_sw, fused_chunk_sw):
+    """Same contract at the canonical full-length geometry (slow: one
+    extra full fused surface_wave execution tier-1 doesn't need — the
+    small-scene test above pins the same branch)."""
+    s, f = chunk_result_sw, fused_chunk_sw
+    assert int(jax.device_get(f.n_windows)) == s.n_windows >= 1
+    assert f.vsg_stack is None and s.vsg_stack is None
+    sb, fb = jax.device_get((s.batch, f.batch))
+    np.testing.assert_array_equal(fb.valid, sb.valid)
+    np.testing.assert_array_equal(fb.data, sb.data)
+    assert _peak_rel(f.disp_image, s.disp_image) < ORACLE_BAR
+
+
+# --------------------------------------------------------------------------
+# degenerate chunks: the on-device masking must survive n_windows == 0
+# without a host branch, reusing the already-compiled program
+# --------------------------------------------------------------------------
+
+def test_fused_all_invalid_windows(small_scene, fused_small_echo):
+    """Superposed close vehicle pair (the echo fixture): tracking still
+    finds vehicles, but no isolation window survives (batch.valid all
+    False on-device) — the fused program must carry that mask through the
+    stack without a host branch."""
+    res = fused_small_echo
+    n, bvalid, tvalid, img = jax.device_get(
+        (res.n_windows, res.batch.valid, res.tracks.valid, res.disp_image))
+    assert tvalid.sum() > 0                    # vehicles ARE tracked...
+    assert int(n) == 0 and not bvalid.any()    # ...but none is isolated
+    assert np.isfinite(img).all()
+
+
+def test_fused_zero_vehicle_chunk_steady_state(small_scene, fused_cfg,
+                                               fused_small_echo):
+    """A zero-signal chunk runs through the SAME cached fused program as
+    the echo fixture (same geometry, different data -> program-cache hit)
+    and comes back with zero windows — and the instrumented run pins the
+    dispatch contract: exactly one fused dispatch, zero jaxpr traces,
+    zero backend compiles in steady state."""
+    from das_diff_veh_tpu.obs import xla_events
+    from das_diff_veh_tpu.obs.registry import MetricsRegistry
+
+    section, _ = small_scene
+    sec = DasSection(np.zeros_like(np.asarray(section.data)),
+                     np.asarray(section.x), np.asarray(section.t))
+
+    reg = MetricsRegistry()
+    watch = xla_events.install(reg)
+    progs0 = F.n_programs()
+    disp0 = F.n_dispatches("process_chunk")
+    try:
+        res = process_chunk(sec, fused_cfg, method="xcorr")
+        n, bvalid, img = jax.device_get(
+            (res.n_windows, res.batch.valid, res.disp_image))
+    finally:
+        xla_events.uninstall(reg)
+
+    assert int(n) == 0 and not bvalid.any()
+    assert np.isfinite(img).all()  # masked stack degrades to zeros, not NaN
+    assert F.n_programs() == progs0            # program-cache hit
+    assert F.n_dispatches("process_chunk") == disp0 + 1
+    assert watch.fused_dispatches == 1         # one dispatch per chunk...
+    assert watch.traces == 0                   # ...zero steady-state retraces
+    assert watch.compiles == 0
+
+
+# --------------------------------------------------------------------------
+# structural pins: zero host syncs inside the fused region, and the
+# detector itself is validated by the staged epilogue
+# --------------------------------------------------------------------------
+
+def test_fused_body_traces_host_sync_free(pipeline_scene, pipeline_cfg):
+    """The fused region proof, per tests/jaxpr_checks.py: (1) ``chunk_body``
+    traces to a jaxpr with the data as an abstract value — so no implicit
+    device->host coercion exists anywhere inside — and (2) the jaxpr
+    contains no callback/infeed primitive that could round-trip at run
+    time.  Together: one dispatch in, one pytree out, nothing in between."""
+    from jaxpr_checks import host_sync_eqns, trace_or_host_sync
+
+    section, _ = pipeline_scene
+    x_dist, t, dt = resolve_chunk_metadata(section, pipeline_cfg)
+    aval = jax.ShapeDtypeStruct(np.shape(section.data),
+                                np.asarray(section.data).dtype)
+
+    jaxpr = trace_or_host_sync(
+        lambda d: chunk_body(d, x_dist, t, dt, pipeline_cfg, method="xcorr"),
+        aval)
+    assert host_sync_eqns(jaxpr) == []
+
+
+def test_staged_epilogue_trips_host_sync_detector(pipeline_scene,
+                                                  pipeline_cfg):
+    """Detector validation: the staged ``process_chunk`` pulls
+    ``n_windows`` to a Python int — tracing it as one region must raise
+    ``HostSync``.  (This is exactly the sync the fused path removes.)"""
+    from jaxpr_checks import HostSync, trace_or_host_sync
+
+    section, _ = pipeline_scene
+    x, t = np.asarray(section.x), np.asarray(section.t)
+    aval = jax.ShapeDtypeStruct(np.shape(section.data),
+                                np.asarray(section.data).dtype)
+
+    with pytest.raises(HostSync):
+        trace_or_host_sync(
+            lambda d: process_chunk(DasSection(d, x, t), pipeline_cfg),
+            aval)
+
+
+# --------------------------------------------------------------------------
+# knob plumbing
+# --------------------------------------------------------------------------
+
+def test_chunk_pipeline_knob(pipeline_cfg, fused_cfg):
+    from das_diff_veh_tpu.runtime.manifest import config_hash
+
+    # an unknown mode fails loudly before touching any data
+    bogus = pipeline_cfg.replace(chunk_pipeline="bogus")
+    sec = DasSection(np.zeros((4, 8)), np.arange(4.0), np.arange(8.0) / 250.0)
+    with pytest.raises(AssertionError):
+        process_chunk(sec, bogus)
+
+    # the knob participates in the runtime config hash: resumed runs and
+    # serve bucket caches never silently mix staged and fused programs
+    assert (config_hash(pipeline_cfg, "xcorr", False)
+            != config_hash(fused_cfg, "xcorr", False))

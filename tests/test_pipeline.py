@@ -31,9 +31,8 @@ def test_process_chunk_xcorr(chunk_result_xcorr):
     assert res.qs_batch is None
 
 
-def test_process_chunk_surface_wave(scene):
-    section, truth = scene
-    res = process_chunk(section, _cfg(), method="surface_wave")
+def test_process_chunk_surface_wave(chunk_result_sw):
+    res = chunk_result_sw
     assert res.n_windows >= 1
     assert res.vsg_stack is None
     assert np.isfinite(np.asarray(res.disp_image)).all()
