@@ -414,8 +414,12 @@ class ServeConfig:
     ``QueueFullError`` once this many requests are waiting."""
 
     batch_window_ms: float = 2.0
-    """How long the dispatcher lingers for same-bucket companions after
-    picking a batch head before executing a partial microbatch."""
+    """Deprecated, ignored.  The dispatcher no longer lingers for
+    companions: batching is *continuous* (iteration-level) — a request
+    arriving while a same-bucket batch is executing is admitted into the
+    open batch slot at the next member boundary, so an idle engine pays
+    zero added latency and a busy engine still coalesces.  The field is
+    kept so existing configs/CLI invocations keep parsing."""
 
     default_deadline_ms: float = 30000.0
     """Deadline applied to requests that do not pass one.  A request still
@@ -448,6 +452,56 @@ class ServeConfig:
     floods — as :class:`~das_diff_veh_tpu.serve.engine.PoisonInputError`
     (HTTP 422) before they can join a microbatch, so one corrupt request
     never contaminates a cohort.  None disables the screen entirely."""
+
+
+@dataclass(frozen=True)
+class MeshServeConfig:
+    """Mesh-distributed multi-tenant serving knobs (``serve.mesh``).
+
+    Wraps a :class:`ServeConfig` (buckets, deadlines, warmup — unchanged
+    semantics) with the placement and tenancy policy of
+    :class:`~das_diff_veh_tpu.serve.mesh.MeshServingEngine`: data-parallel
+    replica workers for independent requests, the channel-sharded ring
+    (``parallel.allpairs``) for large-geometry ones, per-tenant admission
+    quotas and fair-share scheduling.  Execution knobs, not physics — a
+    request computes the same bits wherever it is placed (ring placement
+    bit-exactness is pinned by tests/test_serve_mesh.py).
+    """
+
+    serve: ServeConfig = field(default_factory=ServeConfig)
+    """The wrapped single-engine config; ``serve.max_queue`` bounds the
+    TOTAL queued requests across all replica/ring queues and
+    ``serve.max_batch`` caps each worker's continuous-batch occupancy."""
+
+    replicas: Optional[int] = None
+    """Data-parallel replica workers, one per device.  None = one replica
+    per visible JAX device (capped at the device count); on a single
+    device this degrades to the plain engine plus tenancy."""
+
+    ring_min_channels: Optional[int] = None
+    """Requests with at least this many valid channels route to the
+    channel-sharded ring placement instead of a replica.  None disables
+    the ring route entirely (every request is replica-placed)."""
+
+    ring_devices: Optional[int] = None
+    """Mesh size for ring placements (``parallel.mesh.make_mesh``).
+    None = all visible devices."""
+
+    tenant_quota: int = 32
+    """Per-tenant admission bound: queued + in-flight requests a single
+    tenant may hold.  The next submit over quota sheds with
+    ``TenantQuotaError`` (HTTP 429) — one tenant can saturate at most its
+    quota, never the whole engine."""
+
+    tenant_poison_quarantine: Optional[int] = 3
+    """Consecutive poison sheds (admission health screen) after which a
+    tenant is quarantined: further submits shed with
+    ``TenantQuarantinedError`` until ``release_tenant``.  None disables
+    auto-quarantine (poison requests are still shed individually)."""
+
+    drain_timeout_s: float = 30.0
+    """``drain_tenant``/``drain_replica`` wait at most this long for the
+    target's in-flight requests before returning."""
 
 
 @dataclass(frozen=True)

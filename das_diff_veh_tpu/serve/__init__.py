@@ -15,7 +15,10 @@ module each:
   cache counters as one snapshot dict;
 - :mod:`session` — streaming per-fiber state across consecutive segments;
 - :mod:`imaging` — the production ``process_chunk`` compute factory;
-- :mod:`http` / :mod:`cli` — stdlib JSON endpoint + ``serve`` subcommand.
+- :mod:`http` / :mod:`cli` — stdlib JSON endpoint + ``serve`` subcommand;
+- :mod:`mesh` — the mesh-distributed multi-tenant engine (data-parallel
+  replica workers + the channel-sharded ring + tenant quotas/fair-share/
+  drain; docs/SERVING.md).
 """
 
 from das_diff_veh_tpu.config import ServeConfig
@@ -43,4 +46,10 @@ __all__ = [
     "PoisonInputError", "EngineClosedError", "ShutdownError",
     "normalize_buckets", "pick_bucket", "pad_section", "unpad",
     "make_server", "serve_in_thread",
+    "mesh", "MeshServingEngine",
 ]
+
+# imported LAST: serve.mesh pulls serve.engine/compile_cache back in, so it
+# must only load once this package namespace is fully populated
+from das_diff_veh_tpu.serve import mesh  # noqa: E402
+from das_diff_veh_tpu.serve.mesh import MeshServingEngine  # noqa: E402

@@ -52,7 +52,26 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="sampling rate the warmup time axis assumes [Hz]")
     p.add_argument("--max_batch", type=int, default=4)
     p.add_argument("--max_queue", type=int, default=64)
-    p.add_argument("--batch_window_ms", type=float, default=2.0)
+    p.add_argument("--batch_window_ms", type=float, default=2.0,
+                   help="DEPRECATED, ignored: batching is continuous "
+                        "(iteration-level); kept so existing invocations "
+                        "keep parsing")
+    mesh = p.add_argument_group(
+        "mesh serving",
+        "multi-tenant engine across the device mesh (docs/SERVING.md)")
+    mesh.add_argument("--mesh", action="store_true",
+                      help="serve with the mesh engine: one continuous-"
+                           "batching worker per replica + tenant quotas")
+    mesh.add_argument("--replicas", type=int, default=None, metavar="N",
+                      help="data-parallel replica workers (default: one per "
+                           "visible device)")
+    mesh.add_argument("--ring_min_channels", type=int, default=None,
+                      metavar="NCH",
+                      help="route requests with >= NCH valid channels onto "
+                           "the channel-sharded ring (default: ring off)")
+    mesh.add_argument("--tenant_quota", type=int, default=32,
+                      help="max queued + in-flight requests per tenant "
+                           "(429 beyond)")
     p.add_argument("--deadline_ms", type=float, default=30000.0,
                    help="default per-request deadline")
     p.add_argument("--no_warmup", action="store_true",
@@ -98,8 +117,18 @@ def serve_main(argv=None) -> int:
                                     fs=args.fs)
     # the process-default registry: ring/runtime metrics registered anywhere
     # in this process land in the same GET /metrics scrape as das_serve_*
-    engine = ServingEngine(factory, serve_cfg, tracer=tracer,
-                           registry=default_registry())
+    if args.mesh:
+        from das_diff_veh_tpu.config import MeshServeConfig
+        from das_diff_veh_tpu.serve.mesh import MeshServingEngine
+        engine = MeshServingEngine(
+            factory,
+            MeshServeConfig(serve=serve_cfg, replicas=args.replicas,
+                            ring_min_channels=args.ring_min_channels,
+                            tenant_quota=args.tenant_quota),
+            tracer=tracer, registry=default_registry())
+    else:
+        engine = ServingEngine(factory, serve_cfg, tracer=tracer,
+                               registry=default_registry())
     engine.start()
     server = make_server(engine, args.host, args.port)
     print(f"serving on http://{server.server_address[0]}"

@@ -45,6 +45,17 @@ class ComputeFactory:
     def build(self, bucket: Bucket) -> ComputeFn:
         raise NotImplementedError
 
+    def build_placed(self, bucket: Bucket, placement) -> ComputeFn:
+        """Placement-aware build for mesh serving (``serve.mesh``): called
+        with the :class:`~das_diff_veh_tpu.serve.mesh.Placement` the cache
+        entry is keyed under.  Default ignores the placement — every
+        replica runs the single-device program (a fresh closure per
+        placement, so each replica's jit cache is its own).  Factories
+        with an SPMD variant override this and return the ``shard_map``
+        program for ``placement.kind == "ring"`` (see
+        serve/mesh/allpairs.py)."""
+        return self.build(bucket)
+
     def validate(self, section: DasSection,
                  bucket: Bucket) -> Optional[str]:
         """Admission-time check, called by ``ServingEngine.submit`` after
@@ -82,47 +93,72 @@ class FnComputeFactory(ComputeFactory):
 
 
 class CompiledFunctionCache:
-    """Maps ``(bucket, config_key)`` to a built compute function."""
+    """Maps ``(bucket, config_key, placement)`` to a built compute function.
+
+    ``placement`` is None for the single-device engine (the historical
+    two-part key, unchanged behavior) or a ``serve.mesh.Placement`` — each
+    replica and the ring hold their OWN entry per bucket, so AOT warmup per
+    placement guarantees the zero-steady-state-compile SLO holds on every
+    worker, not just the first one to touch a bucket.
+    """
 
     def __init__(self, factory: ComputeFactory, metrics):
         self._factory = factory
         self._metrics = metrics
         self._lock = threading.Lock()
-        self._programs: Dict[Tuple[Bucket, str], ComputeFn] = {}
+        self._programs: Dict[Tuple[Bucket, str, Optional[str]], ComputeFn] = {}
 
-    def _key(self, bucket: Bucket) -> Tuple[Bucket, str]:
-        return (bucket, self._factory.config_key)
+    def _key(self, bucket: Bucket,
+             placement=None) -> Tuple[Bucket, str, Optional[str]]:
+        return (bucket, self._factory.config_key,
+                None if placement is None else placement.key)
 
-    def warmup(self, bucket: Bucket) -> None:
-        """Build the bucket's program and execute it once on the factory's
-        representative section, so tracing AND the XLA compile happen now."""
-        key = self._key(bucket)
+    def _build(self, bucket: Bucket, placement) -> ComputeFn:
+        if placement is None:
+            return self._factory.build(bucket)
+        return self._factory.build_placed(bucket, placement)
+
+    def warmup(self, bucket: Bucket, placement=None, device=None) -> None:
+        """Build the ``(bucket, placement)`` program and execute it once on
+        the factory's representative section, so tracing AND the XLA
+        compile happen now.  ``device``: run the warmup execution under
+        ``jax.default_device`` so a replica's compile lands on the device
+        its worker will dispatch to."""
+        key = self._key(bucket, placement)
         with self._lock:
             if key in self._programs:
                 return
-            program = self._factory.build(bucket)
+            program = self._build(bucket, placement)
             self._programs[key] = program
         self._metrics.inc("warmup_builds")
         section = self._factory.warmup_section(bucket)
-        program(section, bucket, None)
-        log.info("warmed bucket %s", bucket)
+        if device is not None:
+            import jax
+            with jax.default_device(device):
+                program(section, bucket, None)
+        else:
+            program(section, bucket, None)
+        log.info("warmed bucket %s placement %s", bucket,
+                 None if placement is None else placement.key)
 
-    def get(self, bucket: Bucket) -> ComputeFn:
-        """Program for ``bucket``; builds on miss (counted — steady-state
-        in-bucket traffic after warmup never misses)."""
-        key = self._key(bucket)
+    def get(self, bucket: Bucket, placement=None) -> ComputeFn:
+        """Program for ``(bucket, placement)``; builds on miss (counted —
+        steady-state in-bucket traffic after warmup never misses)."""
+        key = self._key(bucket, placement)
         with self._lock:
             program = self._programs.get(key)
             if program is not None:
                 self._metrics.inc("cache_hits")
                 return program
-            program = self._factory.build(bucket)
+            program = self._build(bucket, placement)
             self._programs[key] = program
         self._metrics.inc("cache_misses")
-        log.info("compiled-cache miss: built bucket %s on demand", bucket)
+        log.info("compiled-cache miss: built bucket %s placement %s "
+                 "on demand", bucket,
+                 None if placement is None else placement.key)
         return program
 
     @property
     def buckets(self):
         with self._lock:
-            return sorted(b for b, _ in self._programs)
+            return sorted({b for b, _, _ in self._programs})

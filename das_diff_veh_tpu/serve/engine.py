@@ -1,10 +1,9 @@
-"""In-process online serving engine: microbatched, bucketed, deadline-aware.
+"""In-process online serving engine: continuously batched, bucketed,
+deadline-aware.
 
 Callers ``submit(section, deadline_ms=..., session=...)`` and get a
 ``concurrent.futures.Future`` back; a single dispatcher thread drains the
-bounded admission queue, groups same-bucket requests into microbatches (up
-to ``ServeConfig.max_batch``, lingering ``batch_window_ms`` for
-companions), pads each request to its bucket, and executes the batch
+bounded admission queue, pads each request to its bucket, and executes it
 through the compiled-function cache.  Overload is shed, not absorbed:
 
 - **reject-on-full** — ``submit`` raises :class:`QueueFullError` once
@@ -13,13 +12,19 @@ through the compiled-function cache.  Overload is shed, not absorbed:
   starts fails with :class:`DeadlineExceededError` (``shed_expired``)
   instead of wasting device time on an answer nobody is waiting for.
 
-Microbatch members execute *serially* through the bucket's one compiled
-program (``process_chunk`` is not vmappable across requests — host-side
-geometry staging picks static slice bounds per call): what batching buys
-is one program lookup and bucket switch per batch, back-to-back device
-dispatches, and coherent deadline checks — not vectorized compute.  The
-flip side is the ``batch_window_ms`` linger a lone request pays on an
-idle engine (default 2 ms, documented in docs/PERF.md).
+Batching is *continuous* (iteration-level, the Orca/vLLM discipline —
+PAPERS.md): the batch slot stays open while members execute, and a
+same-bucket request that arrives mid-batch is admitted into the open slot
+at the next member boundary (counted as ``continuous_admitted``) instead
+of waiting out a linger window.  An idle engine therefore executes a lone
+request immediately — the old ``batch_window_ms`` linger is gone — while
+a busy engine still coalesces up to ``max_batch`` members per
+compiled-program visit.  Members execute *serially* through the bucket's
+one compiled program (``process_chunk`` is not vmappable across requests —
+host-side geometry staging picks static slice bounds per call): what
+batching buys is one program lookup and bucket switch per batch,
+back-to-back device dispatches, and coherent deadline checks — not
+vectorized compute.
 
 Every request is accounted in four spans — queue / pad / compute / unpad —
 emitted through :mod:`das_diff_veh_tpu.runtime.tracing` (the queue span
@@ -115,6 +120,10 @@ class _Request:
     future: Future
     t_submit: float                    # perf_counter seconds
     t_submit_us: float                 # tracer clock (for the queue span)
+    tenant: Optional[str] = None       # mesh engine: quota/fair-share owner
+    session_key: Optional[str] = None  # SessionStore key (mesh engine
+                                       # tenant-namespaces it; base = session)
+    placement: Any = None              # mesh engine: serve.mesh Placement
 
 
 class ServingEngine:
@@ -158,6 +167,7 @@ class ServingEngine:
         self._backlog_lock = threading.Lock()
         self._dispatch_seq = itertools.count()   # serve.dispatch fault keys
         self._closed = threading.Event()
+        self._started = False
         self._thread: Optional[threading.Thread] = None
         self._metrics.bind_queue_depth(
             lambda: self._queue.qsize() + len(self._stash))
@@ -166,8 +176,9 @@ class ServingEngine:
     def start(self) -> "ServingEngine":
         if self._closed.is_set():
             raise EngineClosedError("engine was closed; build a new one")
-        if self._thread is not None:
+        if self._started:
             return self
+        self._started = True
         if self.cfg.compilation_cache_dir:
             from das_diff_veh_tpu.cache import enable_compilation_cache
             enable_compilation_cache(cache_dir=self.cfg.compilation_cache_dir)
@@ -179,8 +190,7 @@ class ServingEngine:
         if self.cfg.warmup:
             with self.tracer.span("warmup", cat="serve",
                                   buckets=list(map(list, self.buckets))):
-                for b in self.buckets:
-                    self.cache.warmup(b)
+                self._warmup_all()
         if self._compile_watch is not None:
             # device-truth SLO gauge: jaxpr traces since warmup finished.
             # The compiled-function cache's own hit/miss counters cannot see
@@ -190,10 +200,21 @@ class ServingEngine:
                 "das_serve_steady_state_compiles",
                 "fresh jit traces since warmup (SLO: stays 0)",
             ).set_fn(lambda: watch.traces - base)
+        self._start_workers()
+        return self
+
+    def _warmup_all(self) -> None:
+        """AOT-compile every configured bucket (the mesh engine overrides
+        this to warm per placement)."""
+        for b in self.buckets:
+            self.cache.warmup(b)
+
+    def _start_workers(self) -> None:
+        """Spawn the execution thread(s); the base engine runs ONE
+        dispatcher, the mesh engine one worker per replica plus the ring."""
         self._thread = threading.Thread(target=self._dispatch_loop,
                                         name="serve-dispatch", daemon=True)
         self._thread.start()
-        return self
 
     def close(self, timeout: float = 30.0) -> None:
         """Stop admitting, drain queued requests, join the dispatcher.
@@ -243,6 +264,7 @@ class ServingEngine:
             for req in backlog + list(self._stash):
                 if not req.future.done():
                     req.future.set_exception(exc)
+                    self._finish(req, "shutdown")
             while True:
                 try:
                     req = self._queue.get_nowait()
@@ -250,22 +272,20 @@ class ServingEngine:
                     return
                 if not req.future.done():
                     req.future.set_exception(exc)
+                    self._finish(req, "shutdown")
         while True:
             req = self._next_request(timeout=0.0)
             if req is None:
                 return
             if not req.future.done():
                 req.future.set_exception(exc)
+                self._finish(req, "shutdown")
 
     # -- submission ----------------------------------------------------------
-    def submit(self, section: DasSection, deadline_ms: Optional[float] = None,
-               session: Optional[str] = None) -> Future:
-        """Enqueue one request; returns a Future resolving to the compute
-        result (or raising the shed/compute error).  Raises immediately on
-        backpressure (:class:`QueueFullError`) and unservable shapes
-        (:class:`NoBucketError`)."""
-        if self._closed.is_set():
-            raise EngineClosedError("engine is closed")
+    def _admit_checks(self, section: DasSection,
+                      session: Optional[str]) -> Tuple[Tuple[int, int], Bucket]:
+        """Shape/geometry/health admission gauntlet shared with the mesh
+        engine: returns ``(valid, bucket)`` or raises the shed error."""
         valid = tuple(int(s) for s in section.data.shape)
         bucket = pick_bucket(valid, self.buckets)
         if bucket is None:
@@ -294,13 +314,28 @@ class ServingEngine:
                 self._record_shed("poison", valid, bucket, session,
                                   **health.summary())
                 raise PoisonInputError(verdict, health)
+        return valid, bucket
+
+    def submit(self, section: DasSection, deadline_ms: Optional[float] = None,
+               session: Optional[str] = None,
+               tenant: Optional[str] = None) -> Future:
+        """Enqueue one request; returns a Future resolving to the compute
+        result (or raising the shed/compute error).  Raises immediately on
+        backpressure (:class:`QueueFullError`) and unservable shapes
+        (:class:`NoBucketError`).  ``tenant`` is accepted for interface
+        parity with the mesh engine and ignored here — the single-device
+        engine has no quotas (``serve.mesh.MeshServingEngine`` enforces
+        them)."""
+        if self._closed.is_set():
+            raise EngineClosedError("engine is closed")
+        valid, bucket = self._admit_checks(section, session)
         if deadline_ms is None:
             deadline_ms = self.cfg.default_deadline_ms
         now = time.perf_counter()
         req = _Request(section=section, valid=valid, bucket=bucket,
                        deadline=now + deadline_ms / 1e3, session=session,
                        future=Future(), t_submit=now,
-                       t_submit_us=self.tracer.now_us())
+                       t_submit_us=self.tracer.now_us(), session_key=session)
         try:
             self._queue.put_nowait(req)
         except queue.Full:
@@ -324,9 +359,11 @@ class ServingEngine:
     def process(self, section: DasSection,
                 deadline_ms: Optional[float] = None,
                 session: Optional[str] = None,
-                timeout: Optional[float] = None) -> Any:
+                timeout: Optional[float] = None,
+                tenant: Optional[str] = None) -> Any:
         """Blocking convenience wrapper around :meth:`submit`."""
-        return self.submit(section, deadline_ms, session).result(timeout)
+        return self.submit(section, deadline_ms, session,
+                           tenant=tenant).result(timeout)
 
     def _record_shed(self, cause: str, valid, bucket, session,
                      **fields) -> None:
@@ -348,6 +385,13 @@ class ServingEngine:
         return self.sessions.get(session)
 
     # -- dispatcher ----------------------------------------------------------
+    def _finish(self, req: _Request, outcome: str) -> None:
+        """Terminal-outcome hook, called exactly once per request from
+        whichever path resolves its future (``completed`` / ``error`` /
+        ``expired`` / ``shutdown``).  Base engine: nothing to release; the
+        mesh engine returns the tenant's quota slot and records per-tenant
+        outcome counters here."""
+
     def _expired(self, req: _Request) -> bool:
         if time.perf_counter() <= req.deadline:
             return False
@@ -360,6 +404,7 @@ class ServingEngine:
             req.future.set_exception(DeadlineExceededError(
                 f"deadline passed after "
                 f"{(time.perf_counter() - req.t_submit) * 1e3:.1f} ms in queue"))
+        self._finish(req, "expired")
         return True
 
     def _next_request(self, timeout: float) -> Optional[_Request]:
@@ -370,21 +415,20 @@ class ServingEngine:
         except queue.Empty:
             return None
 
-    def _next_same_bucket(self, bucket: Bucket,
-                          linger_end: float) -> Optional[_Request]:
-        """A live same-bucket companion from stash/queue, or None once the
-        linger window closes.  Other-bucket requests are stashed (they head
-        a later batch, in arrival order)."""
+    def _poll_same_bucket(self, bucket: Bucket) -> Optional[_Request]:
+        """A same-bucket companion waiting NOW, or None — the continuous
+        batching admission point, called between member executions with the
+        batch slot still open.  No linger: whatever already sits in the
+        stash or the admission queue is considered, nothing is waited for.
+        Other-bucket requests are stashed (they head a later batch, in
+        arrival order)."""
         for i, r in enumerate(self._stash):
             if r.bucket == bucket:
                 del self._stash[i]
                 return r
         while True:
-            remaining = linger_end - time.perf_counter()
-            if remaining <= 0:
-                return None
             try:
-                r = self._queue.get(timeout=remaining)
+                r = self._queue.get_nowait()
             except queue.Empty:
                 return None
             if self._expired(r):
@@ -403,72 +447,99 @@ class ServingEngine:
                 continue
             if self._expired(head):
                 continue
-            batch = [head]
-            with self._backlog_lock:
-                self._batch_backlog.append(head)
-            linger_end = time.perf_counter() + self.cfg.batch_window_ms / 1e3
-            while len(batch) < self.cfg.max_batch:
-                nxt = self._next_same_bucket(head.bucket, linger_end)
-                if nxt is None:
-                    break
-                batch.append(nxt)
-                with self._backlog_lock:
-                    self._batch_backlog.append(nxt)
-            self._execute(batch)
+            self._run_batch(head)
 
-    def _execute(self, batch) -> None:
-        bucket = batch[0].bucket
-        program = self.cache.get(bucket)
-        self._metrics.observe_batch(len(batch))
-        self.tracer.counter("serve_batch", occupancy=len(batch))
-        for req in batch:
-            with self._backlog_lock:   # req is now in-flight, not backlog
-                if self._batch_backlog and self._batch_backlog[0] is req:
-                    self._batch_backlog.popleft()
-            if req.future.done():      # failed by a wedged-dispatcher close
-                continue
-            if self._expired(req):     # deadline may pass while batching
-                continue
-            t_dq = time.perf_counter()
-            self.tracer.complete("queue", req.t_submit_us, cat="serve",
-                                 bucket=list(bucket))
-            try:
-                # chaos site: per-request dispatch failure INSIDE the try —
-                # an injected fault fails this one future, not the cohort
-                faults.fire("serve.dispatch", next(self._dispatch_seq))
-                t0 = time.perf_counter()
-                with self.tracer.span("pad", cat="serve",
-                                      valid=list(req.valid),
-                                      bucket=list(bucket)):
-                    padded = pad_section(req.section, bucket)
-                t1 = time.perf_counter()
-                with self.tracer.span("compute", cat="serve",
-                                      bucket=list(bucket)):
-                    result, state = program(padded, req.valid,
-                                            self.sessions.get(req.session))
-                t2 = time.perf_counter()
-                with self.tracer.span("unpad", cat="serve"):
-                    self.sessions.put(req.session, state)
-                    if not req.future.done():
-                        req.future.set_result(result)
-                t3 = time.perf_counter()
-            except Exception as e:
-                self._metrics.inc("errors")
-                log.exception("request failed in bucket %s", bucket)
-                self.flight.record("error", shape=list(req.valid),
-                                   bucket=list(bucket), session=req.session,
-                                   error=f"{type(e).__name__}: {e}")
-                self.flight.dump("error", bucket=list(bucket))
+    def _run_batch(self, head: _Request, placement: Any = None,
+                   poll=None) -> int:
+        """Continuous batch anchored at ``head``: execute it immediately,
+        then keep admitting same-bucket companions into the open slot at
+        each member boundary (``poll``, default :meth:`_poll_same_bucket`)
+        until none is waiting or ``max_batch`` members ran.  Members after
+        the head are exactly the continuous admissions
+        (``continuous_admitted``).  Returns the batch occupancy."""
+        bucket = head.bucket
+        program = self.cache.get(bucket, placement)
+        poll = poll if poll is not None else self._poll_same_bucket
+        occupancy = 0
+        req: Optional[_Request] = head
+        while req is not None:
+            with self._backlog_lock:
+                self._batch_backlog.append(req)
+            if occupancy > 0:
+                self._metrics.inc("continuous_admitted")
+            self._execute_one(req, bucket, program, placement)
+            occupancy += 1
+            if occupancy >= self.cfg.max_batch:
+                break
+            req = poll(bucket)
+        self._metrics.observe_batch(occupancy)
+        self.tracer.counter("serve_batch", occupancy=occupancy)
+        return occupancy
+
+    def _call_program(self, program, padded: DasSection, req: _Request,
+                      placement: Any):
+        """Run the compiled program for one member — the mesh engine wraps
+        this in the placement's device context."""
+        return program(padded, req.valid, self.sessions.get(req.session_key))
+
+    def _execute_one(self, req: _Request, bucket: Bucket, program,
+                     placement: Any = None) -> None:
+        with self._backlog_lock:       # req is now in-flight, not backlog
+            if self._batch_backlog and self._batch_backlog[0] is req:
+                self._batch_backlog.popleft()
+            else:
+                # mesh engine: several workers interleave one shared
+                # backlog, so this member may not be at the head
+                try:
+                    self._batch_backlog.remove(req)
+                except ValueError:
+                    pass
+        if req.future.done():          # failed by a wedged-dispatcher close
+            return
+        if self._expired(req):         # deadline may pass while batching
+            return
+        t_dq = time.perf_counter()
+        self.tracer.complete("queue", req.t_submit_us, cat="serve",
+                             bucket=list(bucket))
+        try:
+            # chaos site: per-request dispatch failure INSIDE the try —
+            # an injected fault fails this one future, not the cohort
+            faults.fire("serve.dispatch", next(self._dispatch_seq))
+            t0 = time.perf_counter()
+            with self.tracer.span("pad", cat="serve",
+                                  valid=list(req.valid),
+                                  bucket=list(bucket)):
+                padded = pad_section(req.section, bucket)
+            t1 = time.perf_counter()
+            with self.tracer.span("compute", cat="serve",
+                                  bucket=list(bucket)):
+                result, state = self._call_program(program, padded, req,
+                                                   placement)
+            t2 = time.perf_counter()
+            with self.tracer.span("unpad", cat="serve"):
+                self.sessions.put(req.session_key, state)
                 if not req.future.done():
-                    req.future.set_exception(e)
-                continue
-            stages = {"queue": (t_dq - req.t_submit) * 1e3,
-                      "pad": (t1 - t0) * 1e3,
-                      "compute": (t2 - t1) * 1e3,
-                      "unpad": (t3 - t2) * 1e3}
-            self._metrics.observe_request((t3 - req.t_submit) * 1e3, stages)
-            self.flight.record("request", shape=list(req.valid),
+                    req.future.set_result(result)
+            t3 = time.perf_counter()
+        except Exception as e:
+            self._metrics.inc("errors")
+            log.exception("request failed in bucket %s", bucket)
+            self.flight.record("error", shape=list(req.valid),
                                bucket=list(bucket), session=req.session,
-                               total_ms=round((t3 - req.t_submit) * 1e3, 3),
-                               stages_ms={k: round(v, 3)
-                                          for k, v in stages.items()})
+                               error=f"{type(e).__name__}: {e}")
+            self.flight.dump("error", bucket=list(bucket))
+            if not req.future.done():
+                req.future.set_exception(e)
+            self._finish(req, "error")
+            return
+        stages = {"queue": (t_dq - req.t_submit) * 1e3,
+                  "pad": (t1 - t0) * 1e3,
+                  "compute": (t2 - t1) * 1e3,
+                  "unpad": (t3 - t2) * 1e3}
+        self._metrics.observe_request((t3 - req.t_submit) * 1e3, stages)
+        self._finish(req, "completed")
+        self.flight.record("request", shape=list(req.valid),
+                           bucket=list(bucket), session=req.session,
+                           total_ms=round((t3 - req.t_submit) * 1e3, 3),
+                           stages_ms={k: round(v, 3)
+                                      for k, v in stages.items()})

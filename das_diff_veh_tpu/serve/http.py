@@ -4,9 +4,11 @@ Deliberately minimal — a demo/debug surface, not a production gateway (no
 auth, JSON-array payloads, one engine per server):
 
 - ``POST /v1/process`` — body ``{"data": [[...]], "x": [...], "t": [...],
-  "deadline_ms": opt, "session": opt}``; responds with the result summary
-  (``?image=1`` to inline the full image values).
-- ``GET /v1/metrics`` — the engine's legacy JSON metrics snapshot.
+  "deadline_ms": opt, "session": opt, "tenant": opt}``; responds with the
+  result summary (``?image=1`` to inline the full image values).
+- ``GET /v1/metrics`` — the engine's legacy JSON metrics snapshot.  When
+  the engine is a ``serve.mesh.MeshServingEngine`` the SAME payload grows
+  the per-replica / placement / per-tenant views (no second endpoint).
 - ``GET /metrics`` — Prometheus text exposition of the engine's registry
   (``das_serve_*`` families, plus whatever else registered into the same
   registry — the serve CLI passes the process default registry, so runtime
@@ -14,13 +16,18 @@ auth, JSON-array payloads, one engine per server):
 - ``GET /healthz`` — liveness + configured buckets.
 
 Shed responses map onto HTTP status codes: 429 for backpressure
-(queue full), 504 for a deadline that expired in queue, 413 for a shape no
+(queue full) and for the mesh engine's per-tenant sheds (quota reached,
+quarantined, draining — the structured body carries ``cause`` so one
+status code stays diagnosable), 503 when every replica is draining,
+504 for a deadline that expired in queue, 413 for a shape no
 bucket fits, 400 for malformed payloads and for requests the compute
 factory's admission check rejects (e.g. geometry that does not match the
 warmed programs), and 422 for poison inputs the admission health screen
 sheds (NaN/Inf bursts, dead-channel floods) — the 422 body is structured
 (``{"error", "nan_fraction", "dead_channels"}``) so the producer side can
-diagnose its interrogator instead of parsing prose.
+diagnose its interrogator instead of parsing prose.  Tenancy errors are
+mapped via their ``http_status`` class attribute rather than imports, so
+this module never depends on ``serve.mesh``.
 """
 
 from __future__ import annotations
@@ -37,7 +44,7 @@ from das_diff_veh_tpu.core.section import DasSection
 from das_diff_veh_tpu.serve.engine import (DeadlineExceededError,
                                            InvalidRequestError, NoBucketError,
                                            PoisonInputError, QueueFullError,
-                                           ServingEngine)
+                                           ServingEngine, ShedError)
 
 
 def _jsonable(obj, full_arrays: bool = False):
@@ -118,13 +125,14 @@ class ServeHandler(BaseHTTPRequestHandler):
             if deadline_ms is not None:
                 deadline_ms = float(deadline_ms)
             session = payload.get("session")
+            tenant = payload.get("tenant")
         except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
             self._reply(400, {"error": f"bad request: {e}"})
             return
         section = DasSection(data, x, t)
         try:
             future = self.engine.submit(section, deadline_ms=deadline_ms,
-                                        session=session)
+                                        session=session, tenant=tenant)
             result = future.result()
         except QueueFullError as e:
             self._reply(429, {"error": str(e)})
@@ -146,6 +154,15 @@ class ServeHandler(BaseHTTPRequestHandler):
             return
         except DeadlineExceededError as e:
             self._reply(504, {"error": str(e)})
+            return
+        except ShedError as e:
+            # mesh tenancy/placement sheds (TenantQuotaError & co. declare
+            # their status via http_status); the cause field keeps the
+            # shared 429 diagnosable without a per-class handler here
+            cause = type(e).__name__.removeprefix("Tenant") \
+                .removesuffix("Error").lower()
+            self._reply(getattr(e, "http_status", 400),
+                        {"error": str(e), "cause": cause, "tenant": tenant})
             return
         except Exception as e:
             self._reply(500, {"error": f"{type(e).__name__}: {e}"})

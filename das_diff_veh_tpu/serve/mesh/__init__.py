@@ -1,0 +1,26 @@
+"""Mesh-distributed multi-tenant serving (docs/SERVING.md).
+
+``MeshServingEngine`` fans the continuous-batching dispatcher across the
+device mesh: data-parallel replica workers for independent requests, the
+channel-sharded ring for large geometries, per-tenant quotas / fair-share
+/ drain on top.  See serve/mesh/engine.py for the architecture overview.
+"""
+
+from das_diff_veh_tpu.serve.mesh.allpairs import (AllPairsComputeFactory,
+                                                  AllPairsResult)
+from das_diff_veh_tpu.serve.mesh.engine import (DEFAULT_TENANT,
+                                                MeshServingEngine,
+                                                NoReplicaError)
+from das_diff_veh_tpu.serve.mesh.placement import (RING, Placement,
+                                                   PlacementPolicy)
+from das_diff_veh_tpu.serve.mesh.tenancy import (FairQueue, TenantDrainingError,
+                                                 TenantQuarantinedError,
+                                                 TenantQuotaError, TenantTable)
+
+__all__ = [
+    "MeshServingEngine", "NoReplicaError", "DEFAULT_TENANT",
+    "Placement", "RING", "PlacementPolicy",
+    "TenantTable", "FairQueue",
+    "TenantQuotaError", "TenantQuarantinedError", "TenantDrainingError",
+    "AllPairsComputeFactory", "AllPairsResult",
+]

@@ -1,0 +1,118 @@
+"""All-pairs xcorr compute factory: the mesh engine's SPMD route.
+
+The large-geometry request class the ring placement exists for: an
+``(n_ch, n_ch)`` peak-lag cross-correlation matrix over every channel
+pair, quadratic in the channel count.  The factory serves it two ways:
+
+- :meth:`build` — the single-device program
+  (:func:`~das_diff_veh_tpu.ops.pallas_xcorr.xcorr_all_pairs_peak`),
+  what replica placements and the plain :class:`ServingEngine` run;
+- :meth:`build_placed` with ``placement.kind == "ring"`` — the
+  channel-sharded ``shard_map`` ring
+  (:func:`~das_diff_veh_tpu.parallel.allpairs.sharded_all_pairs_peak`):
+  each device keeps its channel block resident and source blocks rotate
+  by ``lax.ppermute``, so the full matrix never materializes per device.
+
+On the kernel path (``use_pallas=True``; ``interpret=True`` on CPU) the
+two programs are **bit-exact** — the ring computes the same FP ops in the
+same order per pair, only on different devices (pinned by PR 4's
+tests/test_parallel.py and re-pinned THROUGH the two engines by
+tests/test_serve_mesh.py) — so routing a request to the ring is purely a
+placement decision, never a numerics decision.
+
+Per-pair independence also makes bucket padding safe for the trim: padded
+rows only add rows/cols ≥ ``valid[0]`` to the matrix, which the compute fn
+slices off; the surviving entries are computed from the untouched real
+channels.  Zero-padded *time* samples do perturb a pair's correlation, so
+(as everywhere in serving) buckets should tile the real ``nt`` — the
+result carries ``padded`` for callers to tell.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from das_diff_veh_tpu.core.section import DasSection
+from das_diff_veh_tpu.serve.buckets import Bucket
+from das_diff_veh_tpu.serve.compile_cache import ComputeFactory, ComputeFn
+
+
+@dataclass
+class AllPairsResult:
+    """One served all-pairs request: the peak matrix + provenance."""
+
+    peaks: np.ndarray                  # (valid_nch, valid_nch)
+    valid: Tuple[int, int]
+    bucket: Bucket
+    placement: str                     # "single" | "ring"
+    padded: bool
+
+
+class AllPairsComputeFactory(ComputeFactory):
+    """Builds per-bucket all-pairs peak programs, ring-capable.
+
+    ``mesh`` is only required once a ring placement is warmed; replicas
+    and the single-device engine never touch it.  ``use_pallas=True,
+    interpret=True`` is the CPU-testable kernel path — the configuration
+    under which single-device and ring programs are bit-exact.
+    """
+
+    def __init__(self, wlen: int, mesh=None, overlap_ratio: float = 0.5,
+                 src_chunk: int = 64, use_pallas: Optional[bool] = None,
+                 interpret: bool = False, ring: Optional[bool] = None):
+        self.wlen = int(wlen)
+        self.mesh = mesh
+        self.overlap_ratio = float(overlap_ratio)
+        self.src_chunk = int(src_chunk)
+        self.use_pallas = use_pallas
+        self.interpret = interpret
+        self.ring = ring
+        self.config_key = (
+            f"allpairs:w{self.wlen}:o{self.overlap_ratio}:"
+            f"c{self.src_chunk}:p{self.use_pallas}:i{self.interpret}")
+
+    def _result(self, peaks, valid: Tuple[int, int], bucket: Bucket,
+                placement: str) -> AllPairsResult:
+        n = int(valid[0])
+        return AllPairsResult(peaks=np.asarray(peaks)[:n, :n],
+                              valid=tuple(valid), bucket=bucket,
+                              placement=placement,
+                              padded=tuple(valid) != tuple(bucket))
+
+    def build(self, bucket: Bucket) -> ComputeFn:
+        from das_diff_veh_tpu.ops.pallas_xcorr import xcorr_all_pairs_peak
+
+        def compute(section: DasSection, valid: Tuple[int, int],
+                    state: Any) -> Tuple[AllPairsResult, Any]:
+            peaks = xcorr_all_pairs_peak(
+                section.data, self.wlen, overlap_ratio=self.overlap_ratio,
+                src_chunk=self.src_chunk, use_pallas=self.use_pallas,
+                interpret=self.interpret)
+            return self._result(peaks, valid, bucket, "single"), state
+
+        return compute
+
+    def build_placed(self, bucket: Bucket, placement) -> ComputeFn:
+        if placement.kind != "ring":
+            return self.build(bucket)
+        if self.mesh is None:
+            raise ValueError(
+                "AllPairsComputeFactory needs a mesh to serve ring "
+                "placements; pass mesh=parallel.mesh.make_mesh(...)")
+        from das_diff_veh_tpu.parallel.allpairs import sharded_all_pairs_peak
+
+        mesh = self.mesh
+
+        def compute(section: DasSection, valid: Tuple[int, int],
+                    state: Any) -> Tuple[AllPairsResult, Any]:
+            peaks = sharded_all_pairs_peak(
+                section.data, self.wlen, mesh,
+                overlap_ratio=self.overlap_ratio, src_chunk=self.src_chunk,
+                use_pallas=self.use_pallas, interpret=self.interpret,
+                ring=self.ring)
+            return self._result(peaks, valid, bucket, "ring"), state
+
+        return compute

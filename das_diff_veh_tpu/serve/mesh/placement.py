@@ -1,0 +1,102 @@
+"""Placement scheduling: which worker runs an admitted request.
+
+The mesh engine owns two kinds of execution targets:
+
+- **replica workers** — one per device, each draining its own fair queue
+  through the single-device program under ``jax.default_device``; the
+  scaling unit for independent requests (data parallelism across the
+  request stream, not inside a program);
+- **the ring worker** — one thread dispatching the channel-sharded
+  ``shard_map`` program (``parallel.allpairs``) across the WHOLE mesh; the
+  route for large-geometry requests whose per-device memory or latency a
+  single replica cannot hold.
+
+:class:`PlacementPolicy` decides at admission time, in strict priority
+order:
+
+1. **ring** — the request's valid channel count reaches
+   ``ring_min_channels`` (None disables the route);
+2. **sticky replica** — a session's requests pin to one replica so
+   per-session state updates keep their execution order (session state is
+   threaded through the compute chain; two replicas interleaving one
+   session would race it).  Stickiness survives until the replica drains;
+3. **least-loaded replica** — smallest queue depth among non-draining
+   replicas (ties to the lowest index, keeping the decision
+   deterministic for the counter assertions in tests).
+
+Every decision is counted per target in
+``das_serve_placements_total{placement=...}`` by the engine, so scheduler
+behavior is asserted from counters, not log prose.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+
+@dataclass(frozen=True)
+class Placement:
+    """One execution target: a replica index or the ring."""
+
+    kind: str                          # "replica" | "ring"
+    index: int = 0                     # replica index; the ring uses 0
+
+    @property
+    def key(self) -> str:
+        """Stable string form — the compile-cache key part and the
+        ``placement`` label value."""
+        return f"{self.kind}:{self.index}"
+
+
+RING = Placement("ring", 0)
+
+
+class PlacementPolicy:
+    """Admission-time placement with session stickiness (thread-safe:
+    ``place`` runs on arbitrary submit threads)."""
+
+    def __init__(self, n_replicas: int,
+                 ring_min_channels: Optional[int] = None):
+        self.n_replicas = int(n_replicas)
+        self.ring_min_channels = ring_min_channels
+        self._lock = threading.Lock()
+        self._sticky: Dict[str, int] = {}
+
+    def place(self, valid_nch: int, session_key: Optional[str],
+              depths: List[int],
+              draining: List[bool]) -> Optional[Placement]:
+        """The target for a request with ``valid_nch`` true channels, or
+        None when every replica is draining (the engine sheds).  ``depths``
+        and ``draining`` are the engine's per-replica queue-depth and
+        drain-flag snapshots."""
+        if (self.ring_min_channels is not None
+                and valid_nch >= self.ring_min_channels):
+            return RING
+        with self._lock:
+            if session_key is not None:
+                idx = self._sticky.get(session_key)
+                if idx is not None and not draining[idx]:
+                    return Placement("replica", idx)
+            alive = [i for i in range(self.n_replicas) if not draining[i]]
+            if not alive:
+                return None
+            idx = min(alive, key=lambda i: (depths[i], i))
+            if session_key is not None:
+                self._sticky[session_key] = idx
+            return Placement("replica", idx)
+
+    def evict_replica(self, index: int) -> int:
+        """Forget stickiness onto a draining replica: its sessions re-pin
+        to a surviving replica on their next request.  Returns how many
+        sessions were evicted."""
+        with self._lock:
+            doomed = [k for k, v in self._sticky.items() if v == index]
+            for k in doomed:
+                del self._sticky[k]
+            return len(doomed)
+
+    def sticky_replica(self, session_key: str) -> Optional[int]:
+        with self._lock:
+            return self._sticky.get(session_key)

@@ -1,0 +1,287 @@
+"""Multi-tenancy: admission quotas, fair-share queues, quarantine, drain.
+
+One mesh engine serves many tenants (fleet monitoring: each roadside fiber
+operator is a tenant submitting its own sessions).  Tenancy is three
+mechanisms, each shedding with its own error so the HTTP front can map
+them to distinct status codes:
+
+- **quota** (:class:`TenantTable.admit`) — a tenant may hold at most
+  ``quota`` queued + in-flight requests; the next submit sheds with
+  :class:`TenantQuotaError` (HTTP 429).  One tenant saturates at most its
+  quota, never the engine;
+- **quarantine** — ``poison_after`` consecutive poison sheds (the
+  admission health screen, PR 7) auto-quarantines the tenant: further
+  submits shed with :class:`TenantQuarantinedError` until
+  ``release_tenant``.  A healthy admission resets the streak;
+- **drain** — ``drain_tenant`` marks the tenant draining
+  (:class:`TenantDrainingError` for new submits), fails its queued
+  requests with ``ShutdownError`` (PR 7 semantics), waits out its
+  in-flight ones, then drops its sessions and record.
+
+:class:`FairQueue` is the per-worker scheduling structure: per-tenant FIFO
+subqueues drained least-recently-served-tenant first (round-robin over
+active tenants by a monotonic pick sequence).  Per-tenant order is never
+reordered — the continuous-batch poll (:meth:`FairQueue.poll_bucket`)
+considers only each tenant's HEAD request, so session state still updates
+in submission order — but ACROSS tenants a flood from one tenant cannot
+starve another's next request behind its backlog.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from das_diff_veh_tpu.serve.engine import ShedError
+
+
+class TenantQuotaError(ShedError):
+    """The tenant's queued + in-flight requests are at its quota."""
+
+    http_status = 429                  # per-tenant backpressure
+
+
+class TenantQuarantinedError(ShedError):
+    """The tenant is quarantined (poison streak or operator action); all
+    its submits shed until ``release_tenant``."""
+
+    http_status = 429
+
+
+class TenantDrainingError(ShedError):
+    """The tenant is being drained; new submits shed until the drain
+    completes."""
+
+    http_status = 429
+
+
+@dataclass
+class TenantState:
+    admitted: int = 0          # queued + in-flight right now (quota charge)
+    submitted: int = 0         # lifetime admissions
+    poison_streak: int = 0     # consecutive poison sheds
+    draining: bool = False
+    quarantined: bool = False
+
+
+class TenantTable:
+    """Thread-safe per-tenant admission state (quota / quarantine / drain).
+
+    ``release`` must be called exactly once per admitted request on its
+    terminal outcome — the engine's ``_finish`` hook does, from every path
+    that resolves a future.
+    """
+
+    def __init__(self, quota: int, poison_after: Optional[int] = None):
+        self.quota = int(quota)
+        self.poison_after = poison_after
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._tenants: Dict[str, TenantState] = {}
+
+    def _state(self, tenant: str) -> TenantState:
+        st = self._tenants.get(tenant)
+        if st is None:
+            st = self._tenants[tenant] = TenantState()
+        return st
+
+    def gate(self, tenant: str) -> None:
+        """The pre-validation shed gate: quarantined and draining tenants
+        are rejected before the engine spends validation/health work on
+        their payload."""
+        with self._lock:
+            st = self._tenants.get(tenant)
+            if st is None:
+                return
+            if st.quarantined:
+                raise TenantQuarantinedError(
+                    f"tenant {tenant!r} is quarantined "
+                    f"(poison streak {st.poison_streak}); "
+                    "release_tenant() to readmit")
+            if st.draining:
+                raise TenantDrainingError(f"tenant {tenant!r} is draining")
+
+    def admit(self, tenant: str) -> None:
+        """Charge one quota slot or shed with :class:`TenantQuotaError`."""
+        with self._lock:
+            st = self._state(tenant)
+            if st.admitted >= self.quota:
+                raise TenantQuotaError(
+                    f"tenant {tenant!r} at quota "
+                    f"({st.admitted}/{self.quota} queued + in-flight)")
+            st.admitted += 1
+            st.submitted += 1
+
+    def release(self, tenant: str) -> None:
+        """Return one quota slot (terminal request outcome)."""
+        with self._cond:
+            st = self._tenants.get(tenant)
+            if st is not None and st.admitted > 0:
+                st.admitted -= 1
+                if st.admitted == 0:
+                    self._cond.notify_all()
+
+    def note_poison(self, tenant: str) -> bool:
+        """Record one poison shed; returns True when this crossed the
+        quarantine threshold."""
+        with self._lock:
+            st = self._state(tenant)
+            st.poison_streak += 1
+            if (self.poison_after is not None and not st.quarantined
+                    and st.poison_streak >= self.poison_after):
+                st.quarantined = True
+                return True
+            return False
+
+    def note_healthy(self, tenant: str) -> None:
+        with self._lock:
+            st = self._tenants.get(tenant)
+            if st is not None:
+                st.poison_streak = 0
+
+    def quarantine(self, tenant: str) -> None:
+        with self._lock:
+            self._state(tenant).quarantined = True
+
+    def release_tenant(self, tenant: str) -> None:
+        """Operator override: lift quarantine and reset the streak."""
+        with self._lock:
+            st = self._tenants.get(tenant)
+            if st is not None:
+                st.quarantined = False
+                st.poison_streak = 0
+
+    def start_drain(self, tenant: str) -> None:
+        with self._lock:
+            self._state(tenant).draining = True
+
+    def finish_drain(self, tenant: str) -> None:
+        """Drop the tenant's record entirely: a later submit re-admits it
+        as a fresh tenant."""
+        with self._lock:
+            self._tenants.pop(tenant, None)
+
+    def wait_idle(self, tenant: str, timeout: float) -> bool:
+        """Block until the tenant holds zero quota slots (queued requests
+        were failed by the drain; this waits out the in-flight tail).
+        Returns False on timeout."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                st = self._tenants.get(tenant)
+                if st is None or st.admitted == 0:
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+
+    def inflight(self, tenant: str) -> int:
+        with self._lock:
+            st = self._tenants.get(tenant)
+            return 0 if st is None else st.admitted
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                t: {"admitted": st.admitted, "submitted": st.submitted,
+                    "poison_streak": st.poison_streak,
+                    "draining": st.draining, "quarantined": st.quarantined}
+                for t, st in sorted(self._tenants.items())}
+
+
+@dataclass
+class _SubQueue:
+    q: deque = field(default_factory=deque)
+    last_pick: int = -1                # monotonic round-robin position
+
+
+class FairQueue:
+    """Per-tenant FIFO subqueues, drained fair-share across tenants.
+
+    The pick rule is round-robin by least-recently-served tenant: each
+    pop stamps the tenant with a monotonically increasing sequence number
+    and the next pop takes the non-empty tenant with the OLDEST stamp —
+    so N active tenants each get every Nth slot regardless of backlog
+    sizes, and a new tenant's first request waits at most one rotation.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._sub: Dict[str, _SubQueue] = {}
+        self._seq = 0
+        self._n = 0
+
+    def put(self, req) -> None:
+        tenant = req.tenant if req.tenant is not None else ""
+        with self._cond:
+            sub = self._sub.get(tenant)
+            if sub is None:
+                sub = self._sub[tenant] = _SubQueue()
+            sub.q.append(req)
+            self._n += 1
+            self._cond.notify()
+
+    def _pick_locked(self, eligible: List[str]):
+        tenant = min(eligible, key=lambda t: (self._sub[t].last_pick, t))
+        sub = self._sub[tenant]
+        req = sub.q.popleft()
+        self._seq += 1
+        sub.last_pick = self._seq
+        self._n -= 1
+        return req
+
+    def get(self, timeout: float):
+        """Fair-order head pop, blocking up to ``timeout``; None when
+        nothing arrived."""
+        with self._cond:
+            if self._n == 0:
+                self._cond.wait(timeout)
+            if self._n == 0:
+                return None
+            return self._pick_locked([t for t, s in self._sub.items()
+                                      if s.q])
+
+    def poll_bucket(self, bucket):
+        """Continuous-batch companion poll: the fair-order next request
+        among tenants whose HEAD request matches ``bucket`` (heads only —
+        per-tenant FIFO and therefore per-session execution order is
+        preserved), or None without waiting."""
+        with self._cond:
+            eligible = [t for t, s in self._sub.items()
+                        if s.q and s.q[0].bucket == bucket]
+            if not eligible:
+                return None
+            return self._pick_locked(eligible)
+
+    def take_tenant(self, tenant: str) -> list:
+        """Remove and return every queued request of ``tenant`` (drain)."""
+        with self._cond:
+            sub = self._sub.pop(tenant, None)
+            if sub is None:
+                return []
+            self._n -= len(sub.q)
+            return list(sub.q)
+
+    def drain_all(self) -> list:
+        """Remove and return everything, fair order not preserved."""
+        with self._cond:
+            out = []
+            for sub in self._sub.values():
+                out.extend(sub.q)
+            self._sub.clear()
+            self._n = 0
+            return out
+
+    def qsize(self) -> int:
+        with self._lock:
+            return self._n
+
+    def wake(self) -> None:
+        """Nudge a blocked ``get`` (drain/close paths)."""
+        with self._cond:
+            self._cond.notify_all()

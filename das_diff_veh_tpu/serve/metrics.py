@@ -14,8 +14,27 @@ families (``das_serve_*``):
   the same percentile set as totals (they used to report only means; the
   mean is kept in the snapshot for continuity);
 - ``das_serve_batches_total`` / ``das_serve_batched_requests_total`` /
-  ``das_serve_batch_max_occupancy`` — microbatch accounting;
+  ``das_serve_batch_max_occupancy`` — continuous-batch accounting (the
+  ``continuous_admitted`` event counts members admitted into an already
+  executing batch slot);
 - ``das_serve_queue_depth`` — live depth via a collect-time callback.
+
+The mesh engine (``serve.mesh``) additionally registers — via
+:meth:`ServeMetrics.enable_mesh` — the placement/tenancy families its
+scheduler is counter-asserted on:
+
+- ``das_serve_placements_total{placement=...}`` — placement decisions
+  (``replica:N`` / ``ring:0``);
+- ``das_serve_replica_requests_total{replica=...}`` /
+  ``das_serve_replica_queue_depth{replica=...}`` /
+  ``das_serve_replica_busy{replica=...}`` — per-replica occupancy;
+- ``das_serve_tenant_events_total{tenant=..., event=...}`` /
+  ``das_serve_tenant_latency_ms{tenant=...}`` — per-tenant outcomes and
+  latency histograms.
+
+All of them live in the engine's ONE registry, so the Prometheus scrape
+(``GET /metrics``) and the JSON ``/v1/metrics`` snapshot expose the mesh
+views without a second endpoint.
 
 Each engine defaults to its OWN registry (tests and embedded engines stay
 isolated); the serve CLI passes ``obs.default_registry()`` so runtime and
@@ -39,6 +58,8 @@ class ServeMetrics:
     _COUNTS = ("submitted", "completed", "errors",
                "shed_rejected", "shed_expired", "shed_no_bucket",
                "shed_invalid", "shed_poison",
+               "shed_quota", "shed_quarantined", "shed_draining",
+               "continuous_admitted",
                "cache_hits", "cache_misses", "warmup_builds")
 
     def __init__(self, latency_window: int = 1024,
@@ -66,6 +87,61 @@ class ServeMetrics:
             "das_serve_batch_max_occupancy", "largest microbatch so far")
         self._depth = self.registry.gauge(
             "das_serve_queue_depth", "requests waiting (queue + stash)")
+        self._mesh = False
+        self._placements = None
+        self._replica_reqs = None
+        self._replica_depth = None
+        self._replica_busy = None
+        self._tenant_events = None
+        self._tenant_latency = None
+
+    # -- mesh views (serve.mesh engine only) ---------------------------------
+    def enable_mesh(self, n_replicas: int) -> None:
+        """Register the placement/tenancy families the mesh engine is
+        counter-asserted on; per-replica children are pre-touched so the
+        scrape shape is stable from the first request."""
+        self._mesh = True
+        self._placements = self.registry.counter(
+            "das_serve_placements_total", "placement decisions by target",
+            labels=("placement",))
+        self._replica_reqs = self.registry.counter(
+            "das_serve_replica_requests_total",
+            "requests executed per replica", labels=("replica",))
+        self._replica_depth = self.registry.gauge(
+            "das_serve_replica_queue_depth",
+            "requests waiting per replica queue", labels=("replica",))
+        self._replica_busy = self.registry.gauge(
+            "das_serve_replica_busy",
+            "1 while the replica's worker is executing a batch",
+            labels=("replica",))
+        self._tenant_events = self.registry.counter(
+            "das_serve_tenant_events_total",
+            "per-tenant serving outcomes", labels=("tenant", "event"))
+        self._tenant_latency = self.registry.histogram(
+            "das_serve_tenant_latency_ms",
+            "per-tenant total request latency [ms]", labels=("tenant",),
+            window=self._window)
+        for i in range(n_replicas):
+            self._replica_reqs.labels(replica=str(i))
+            self._replica_busy.labels(replica=str(i))
+
+    def observe_placement(self, placement_key: str) -> None:
+        self._placements.labels(placement=placement_key).inc()
+
+    def observe_replica_request(self, replica: int) -> None:
+        self._replica_reqs.labels(replica=str(replica)).inc()
+
+    def bind_replica_depth(self, replica: int, fn) -> None:
+        self._replica_depth.labels(replica=str(replica)).set_fn(fn)
+
+    def set_replica_busy(self, replica: int, busy: bool) -> None:
+        self._replica_busy.labels(replica=str(replica)).set(1 if busy else 0)
+
+    def observe_tenant(self, tenant: str, event: str) -> None:
+        self._tenant_events.labels(tenant=tenant, event=event).inc()
+
+    def observe_tenant_latency(self, tenant: str, total_ms: float) -> None:
+        self._tenant_latency.labels(tenant=tenant).observe(total_ms)
 
     # -- write side (engine threads) -----------------------------------------
     def inc(self, name: str, by: int = 1) -> None:
@@ -127,4 +203,27 @@ class ServeMetrics:
                 "max_occupancy": int(self._max_occ.value),
             },
         }
+        if self._mesh:
+            snap["placements"] = {
+                key: int(child.value)
+                for (key,), child in self._placements.children()}
+            snap["replicas"] = {
+                idx: {
+                    "requests": int(child.value),
+                    "queue_depth": int(self._replica_depth.labels(
+                        replica=idx).value),
+                    "busy": int(self._replica_busy.labels(replica=idx).value),
+                }
+                for (idx,), child in self._replica_reqs.children()}
+            tenants: dict = {}
+            for (tenant, event), child in self._tenant_events.children():
+                tenants.setdefault(tenant, {})[event] = int(child.value)
+            for (tenant,), child in self._tenant_latency.children():
+                vals = child.values()
+                tenants.setdefault(tenant, {})["latency_ms"] = {
+                    "n": len(vals),
+                    "p50": round(percentile(vals, 0.50), 3),
+                    "p99": round(percentile(vals, 0.99), 3),
+                }
+            snap["tenants"] = tenants
         return snap

@@ -7,14 +7,21 @@ compute receives the state segment k-1 returned (the imaging compute uses
 it to carry the running dispersion-image accumulator and vehicle count, so
 a session behaves like the batch workflow's per-date accumulator).
 
-All state updates happen on the single dispatcher thread in execution
-order, so no per-session locking is needed beyond the store's own map lock.
+All state updates for one session happen on one worker thread in execution
+order (the single dispatcher, or — mesh engine — the session's sticky
+replica), so no per-session locking is needed beyond the store's own map
+lock.
+
+Multi-tenant serving (``serve.mesh``) namespaces sessions per tenant: the
+store key is :meth:`SessionStore.scoped`'s ``"tenant::session"`` string, so
+two tenants naming a session ``"fiber-7"`` never share state, and a tenant
+drain can drop exactly its own sessions (:meth:`drop_tenant`).
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 
 class SessionStore:
@@ -23,6 +30,31 @@ class SessionStore:
     def __init__(self):
         self._lock = threading.Lock()
         self._state: Dict[str, Any] = {}
+
+    @staticmethod
+    def scoped(tenant: Optional[str], session: Optional[str]) -> Optional[str]:
+        """The store key for ``session`` under ``tenant`` (None tenant =
+        the single-tenant engine's bare key)."""
+        if session is None:
+            return None
+        if tenant is None:
+            return session
+        return f"{tenant}::{session}"
+
+    def sessions_for(self, tenant: str) -> List[str]:
+        """Store keys belonging to ``tenant`` (scoped-key prefix match)."""
+        prefix = f"{tenant}::"
+        with self._lock:
+            return [k for k in self._state if k.startswith(prefix)]
+
+    def drop_tenant(self, tenant: str) -> int:
+        """Drop every session of ``tenant``; returns how many were held."""
+        prefix = f"{tenant}::"
+        with self._lock:
+            doomed = [k for k in self._state if k.startswith(prefix)]
+            for k in doomed:
+                del self._state[k]
+            return len(doomed)
 
     def get(self, session: Optional[str]) -> Any:
         if session is None:

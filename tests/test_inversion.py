@@ -262,8 +262,19 @@ class TestCurvePrep:
 
 
 def test_multirun_sharded_over_mesh_matches_unsharded():
-    # restart axis sharded over the 8-virtual-device CPU mesh: results must
-    # be identical to the unsharded run (restarts are independent)
+    """Restart axis sharded over the 8-virtual-device CPU mesh matches the
+    unsharded run (restarts are independent).
+
+    The winning restart is pinned tight (``x_best`` at atol=1e-7 — in
+    practice bit-identical, and the argmin restart index agrees), but the
+    full per-restart ``misfits`` vector gets a measured tolerance: XLA
+    fuses the chaotic-PSO update differently under shard_map, and after
+    10 iterations of a chaotic map a one-ULP divergence in a *losing*
+    restart's trajectory is macroscopic.  Measured on this host: 2/72
+    misfit entries violate rtol=1e-6, worst relative difference 1.9e-3
+    (abs 4.2e-3) — rtol=5e-3 bounds that with margin while still catching
+    any real cross-restart mixup (wrong shard order or a dropped restart
+    changes misfits at O(1))."""
     from das_diff_veh_tpu.inversion import invert_multirun
     from das_diff_veh_tpu.parallel import make_mesh
 
@@ -273,9 +284,11 @@ def test_multirun_sharded_over_mesh_matches_unsharded():
     base = invert_multirun(spec, curves, **kw)
     sharded = invert_multirun(spec, curves, mesh=make_mesh(8), **kw)
     np.testing.assert_allclose(np.asarray(sharded.misfits),
-                               np.asarray(base.misfits), rtol=1e-6)
+                               np.asarray(base.misfits), rtol=5e-3)
     np.testing.assert_allclose(np.asarray(sharded.x_best),
                                np.asarray(base.x_best), atol=1e-7)
+    assert int(np.argmin(np.asarray(sharded.misfits))) == int(
+        np.argmin(np.asarray(base.misfits)))
 
 
 def test_scan_mode_diagnostics_flags_osculating_pair():

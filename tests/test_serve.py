@@ -574,30 +574,64 @@ def test_close_with_wedged_dispatcher_fails_pending_futures():
 
 
 def test_close_with_wedged_dispatcher_fails_batch_tail():
-    """max_batch > 1: members dequeued into the dispatcher's current batch
-    (in neither the queue nor the stash) must also fail with ShutdownError
-    on a wedged close — and the dispatcher skips their dead futures when it
-    unwedges instead of computing for nobody."""
+    """max_batch > 1: with continuous batching the batch slot is still open
+    while the head wedges in compute, so a same-bucket tail submitted
+    meanwhile sits in the admission queue as a WOULD-BE continuous
+    admission.  A wedged close must fail it with ShutdownError — and the
+    unwedged dispatcher must not admit its dead future into the batch."""
     gate = _Gate()
     eng = ServingEngine(FnComputeFactory(gate.build, "gated"),
                         ServeConfig(buckets=((8, 32),), max_batch=4,
-                                    batch_window_ms=1000.0, warmup=False,
+                                    warmup=False,
                                     default_deadline_ms=600000.0)).start()
-    # both submitted inside the 1 s linger window: the dispatcher forms the
-    # batch [wedged, tail] BEFORE compute starts, so once compute wedges the
-    # tail request lives in the batch backlog — neither queue nor stash
     f_wedged = eng.submit(_section(8, 32))
+    assert gate.started.wait(timeout=10.0)     # head is inside compute
     f_tail = eng.submit(_section(8, 32, value=3.0))
-    assert gate.started.wait(timeout=10.0)
-    assert eng._queue.qsize() == 0 and not eng._stash  # both were dequeued
     eng.close(timeout=0.2)
     with pytest.raises(ShutdownError):
         f_tail.result(timeout=5.0)
     gate.release.set()
     assert f_wedged.result(timeout=10.0) == float(
         np.asarray(_section(8, 32).data).sum())
-    # the tail request was skipped, not computed: exactly one compute ran
-    assert eng.metrics()["completed"] == 1
+    # the tail request was failed before the member boundary, not computed:
+    # exactly one compute ran and nothing was continuously admitted
+    snap = eng.metrics()
+    assert snap["completed"] == 1
+    assert snap["continuous_admitted"] == 0
+
+
+def test_continuous_admission_into_inflight_batch():
+    """The tentpole semantics change (ISSUE 18): requests arriving while a
+    same-bucket batch is EXECUTING join its open slot at the next member
+    boundary — one batch, late members counted as ``continuous_admitted`` —
+    instead of waiting out a linger window or heading a second batch."""
+    gate = _Gate()
+    eng = ServingEngine(FnComputeFactory(gate.build, "gated"),
+                        ServeConfig(buckets=((8, 32),), max_batch=4,
+                                    warmup=False,
+                                    default_deadline_ms=600000.0)).start()
+    try:
+        f_head = eng.submit(_section(8, 32))
+        assert gate.started.wait(timeout=10.0)   # head is mid-compute: the
+        gate.started.clear()                     # batch slot is open
+        f_late1 = eng.submit(_section(8, 32, value=2.0))
+        f_late2 = eng.submit(_section(8, 32, value=3.0))
+        gate.release.set()                       # member boundary reached
+        assert f_head.result(timeout=10.0) == float(
+            np.asarray(_section(8, 32).data).sum())
+        assert f_late1.result(timeout=10.0) == float(
+            np.asarray(_section(8, 32, value=2.0).data).sum())
+        assert f_late2.result(timeout=10.0) == float(
+            np.asarray(_section(8, 32, value=3.0).data).sum())
+        snap = eng.metrics()
+        # all three rode ONE batch: the two late arrivals were admitted into
+        # the in-flight slot, no second batch was formed
+        assert snap["batch"]["count"] == 1
+        assert snap["batch"]["max_occupancy"] == 3
+        assert snap["continuous_admitted"] == 2
+    finally:
+        gate.release.set()
+        eng.close()
 
 
 def _poison_engine(**hkw):

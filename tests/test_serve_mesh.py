@@ -1,0 +1,518 @@
+"""Mesh-serving tests: placement, fair-share tenancy, drain, ring numerics.
+
+Runs on the conftest-forced 8-virtual-device CPU platform (the same mesh
+the parallel tests shard over).  Scheduler and tenancy behavior is driven
+with injected stub factories — tier-1 never traces ``process_chunk`` here —
+and asserted from the engine's counters (placements, per-replica requests,
+per-tenant events), not from timing.  The one real-compute case pins the
+ring placement's bit-exactness against the single-device engine on the
+all-pairs kernel path (the PR 4 invariant, re-pinned THROUGH both serving
+engines).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from das_diff_veh_tpu.config import HealthConfig, MeshServeConfig, ServeConfig
+from das_diff_veh_tpu.core.section import DasSection
+from das_diff_veh_tpu.serve import (FnComputeFactory, ServingEngine,
+                                    ShutdownError, serve_in_thread)
+from das_diff_veh_tpu.serve.engine import PoisonInputError
+from das_diff_veh_tpu.serve.mesh import (RING, AllPairsComputeFactory,
+                                         FairQueue, MeshServingEngine,
+                                         PlacementPolicy, TenantDrainingError,
+                                         TenantQuarantinedError,
+                                         TenantQuotaError)
+
+
+# --------------------------------------------------------------------------
+# helpers
+# --------------------------------------------------------------------------
+
+SENTINEL = 999.0
+
+
+def _section(nch, nt, value=1.0):
+    return DasSection(np.full((nch, nt), value, np.float32),
+                      np.arange(nch, dtype=np.float64) * 8.16,
+                      np.arange(nt, dtype=np.float64) / 250.0)
+
+
+def _wedge_section(nch=8, nt=32, value=1.0):
+    sec = _section(nch, nt, value)
+    sec.data[0, 0] = SENTINEL
+    return sec
+
+
+class _MarkGate:
+    """Blocks compute only for sections whose [0, 0] sample carries the
+    sentinel; every other request passes straight through — so one worker
+    can be wedged while its peers (and its own later batch members) run."""
+
+    def __init__(self, order=None):
+        self.started = threading.Event()
+        self.release = threading.Event()
+        self.order = order             # optional execution-order sink
+
+    def build(self, bucket):
+        def fn(section, valid, state):
+            d = np.asarray(section.data)
+            if d[0, 0] == SENTINEL:
+                self.started.set()
+                assert self.release.wait(timeout=30.0)
+            if self.order is not None:
+                self.order.append(float(d[0, 1]))
+            return float(d[:valid[0], :valid[1]].sum()), state
+        return fn
+
+
+def _mesh_engine(replicas=2, buckets=((8, 32),), gate=None, quota=32,
+                 poison_after=None, health=None, max_batch=8, max_queue=64):
+    serve_cfg = ServeConfig(buckets=buckets, max_batch=max_batch,
+                            max_queue=max_queue,
+                            default_deadline_ms=600000.0, health=health)
+    cfg = MeshServeConfig(serve=serve_cfg, replicas=replicas,
+                          tenant_quota=quota,
+                          tenant_poison_quarantine=poison_after)
+    build = gate.build if gate is not None else _MarkGate().build
+    return MeshServingEngine(FnComputeFactory(build, "mesh-test"), cfg).start()
+
+
+class _FakeReq:
+    def __init__(self, tenant, bucket=(8, 32)):
+        self.tenant = tenant
+        self.bucket = bucket
+
+
+# --------------------------------------------------------------------------
+# placement policy + fair queue units
+# --------------------------------------------------------------------------
+
+def test_placement_policy_priority_order():
+    """Ring beats sticky beats least-loaded; draining replicas are never
+    picked; all-draining with no ring route returns None (the engine
+    sheds)."""
+    pol = PlacementPolicy(3, ring_min_channels=100)
+    free = [False, False, False]
+    # 1. ring: channel count at the threshold routes to the ring even for
+    #    a sticky session
+    assert pol.place(100, "s", [0, 0, 0], free) == RING
+    # 2. least-loaded, ties to the lowest index; session "s" pins there
+    assert pol.place(10, "s", [5, 2, 2], free).index == 1
+    # 3. sticky: "s" stays on 1 even when 2 is now emptier
+    assert pol.place(10, "s", [5, 9, 0], free).index == 1
+    assert pol.sticky_replica("s") == 1
+    # 4. draining replica loses its stickiness at eviction
+    assert pol.place(10, None, [3, 0, 1], [False, True, False]).index == 2
+    assert pol.evict_replica(1) == 1
+    assert pol.sticky_replica("s") is None
+    assert pol.place(10, "s", [0, 0, 0], [False, True, False]).index == 0
+    # 5. nowhere to go
+    assert pol.place(10, None, [0, 0, 0], [True, True, True]) is None
+
+
+def test_fair_queue_round_robin_and_head_only_poll():
+    """Pops rotate over tenants by least-recently-picked (a flood from one
+    tenant cannot starve another's next request); the continuous-batch poll
+    only considers each tenant's HEAD, preserving per-tenant FIFO."""
+    q = FairQueue()
+    a1, a2, a3 = _FakeReq("a"), _FakeReq("a"), _FakeReq("a")
+    b1, c1 = _FakeReq("b"), _FakeReq("c")
+    for r in (a1, a2, a3, b1, c1):
+        q.put(r)
+    assert [q.get(0.1) for _ in range(5)] == [a1, b1, c1, a2, a3]
+    assert q.get(0.01) is None and q.qsize() == 0
+    # head-only: tenant a's head is bucket X, so a cannot contribute to a
+    # bucket-Y batch even though a2 (bucket Y) is queued behind it
+    ax = _FakeReq("a", bucket=("X",))
+    ay, by = _FakeReq("a", bucket=("Y",)), _FakeReq("b", bucket=("Y",))
+    for r in (ax, ay, by):
+        q.put(r)
+    assert q.poll_bucket(("Y",)) is by
+    assert q.poll_bucket(("Y",)) is None     # a's head still blocks ay
+    assert q.get(0.1) is ax
+    assert q.poll_bucket(("Y",)) is ay
+
+
+# --------------------------------------------------------------------------
+# mesh engine: round trip, warmup accounting, continuous batching
+# --------------------------------------------------------------------------
+
+def test_mesh_round_trip_and_zero_steady_state_misses():
+    """Requests complete correctly across replicas; warmup builds one
+    program per (bucket, replica) and the steady-state stream performs zero
+    fresh cache builds."""
+    eng = _mesh_engine(replicas=4, buckets=((8, 32), (16, 64)))
+    try:
+        futs = [eng.submit(_section(8, 32, float(i))) for i in range(6)]
+        futs += [eng.submit(_section(12, 48, 2.0)) for _ in range(3)]
+        vals = [f.result(timeout=15) for f in futs]
+        assert vals[:6] == [float(i) * 8 * 32 for i in range(6)]
+        assert vals[6:] == [2.0 * 12 * 48] * 3
+        snap = eng.metrics()
+        assert snap["completed"] == 9
+        assert snap["warmup_builds"] == 2 * 4       # buckets x replicas
+        assert snap["cache_misses"] == 0
+        assert sum(snap["placements"].values()) == 9
+        assert sum(r["requests"] for r in snap["replicas"].values()) == 9
+        assert snap["mesh"]["replicas"] == 4 and not snap["mesh"]["ring"]
+    finally:
+        eng.close()
+
+
+def test_mesh_continuous_admission_into_inflight_batch():
+    """Same-bucket requests arriving while a replica executes are admitted
+    into its open batch slot at the next member boundary — the continuous
+    contract holds per mesh worker, not just on the base dispatcher."""
+    gate = _MarkGate()
+    eng = _mesh_engine(replicas=1, gate=gate)
+    try:
+        f_head = eng.submit(_wedge_section())
+        assert gate.started.wait(timeout=10.0)
+        f1 = eng.submit(_section(8, 32, 2.0))
+        f2 = eng.submit(_section(8, 32, 3.0))
+        deadline = time.monotonic() + 5.0
+        while eng._replicas[0].queue.qsize() < 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        gate.release.set()
+        results = {f.result(timeout=15) for f in (f_head, f1, f2)}
+        assert results == {float(np.asarray(_wedge_section().data).sum()),
+                           2.0 * 8 * 32, 3.0 * 8 * 32}
+        snap = eng.metrics()
+        assert snap["batch"]["count"] == 1
+        assert snap["batch"]["max_occupancy"] == 3
+        assert snap["continuous_admitted"] == 2
+    finally:
+        gate.release.set()
+        eng.close()
+
+
+def test_session_sticky_placement():
+    """Consecutive requests of one session execute on ONE replica (state
+    threading needs a single worker's execution order); a fresh session is
+    free to land elsewhere."""
+    eng = _mesh_engine(replicas=4)
+    try:
+        for i in range(3):
+            eng.submit(_section(8, 32, float(i + 1)),
+                       session="fiber-A").result(timeout=15)
+        snap = eng.metrics()
+        per_replica = [r["requests"] for r in snap["replicas"].values()]
+        assert sorted(per_replica) == [0, 0, 0, 3]
+        assert eng.policy.sticky_replica("default::fiber-A") is not None
+    finally:
+        eng.close()
+
+
+# --------------------------------------------------------------------------
+# tenancy: quota, fair share, quarantine, drain
+# --------------------------------------------------------------------------
+
+def test_tenant_quota_rejection_and_release():
+    """Quota counts queued + in-flight; the over-quota submit sheds with
+    TenantQuotaError; terminal outcomes return the slots (another tenant is
+    untouched throughout)."""
+    gate = _MarkGate()
+    eng = _mesh_engine(replicas=1, gate=gate, quota=2)
+    try:
+        f_wedged = eng.submit(_wedge_section(), tenant="noisy")
+        assert gate.started.wait(timeout=10.0)
+        f_queued = eng.submit(_section(8, 32, 2.0), tenant="noisy")
+        with pytest.raises(TenantQuotaError):
+            eng.submit(_section(8, 32, 3.0), tenant="noisy")
+        # the quota is per tenant, not global
+        f_other = eng.submit(_section(8, 32, 4.0), tenant="quiet")
+        gate.release.set()
+        for f in (f_wedged, f_queued, f_other):
+            f.result(timeout=15)
+        # slots returned: the tenant can submit again
+        assert eng.submit(_section(8, 32, 5.0),
+                          tenant="noisy").result(timeout=15) == 5.0 * 8 * 32
+        snap = eng.metrics()
+        assert snap["shed_quota"] == 1
+        assert snap["tenants"]["noisy"]["shed_quota"] == 1
+        assert snap["tenants"]["noisy"]["completed"] == 3
+        assert snap["tenant_table"]["noisy"]["admitted"] == 0
+    finally:
+        gate.release.set()
+        eng.close()
+
+
+def test_fair_share_across_tenants():
+    """With one tenant's flood queued ahead of another's single request,
+    the worker alternates tenants (least-recently-picked round-robin): the
+    singleton does not wait out the flood."""
+    order = []
+    gate = _MarkGate(order=order)
+    eng = _mesh_engine(replicas=1, gate=gate)
+    order.clear()                      # drop the warmup execution's entry
+    try:
+        f_head = eng.submit(_wedge_section(value=10.0), tenant="head")
+        assert gate.started.wait(timeout=10.0)
+        futs = [eng.submit(_section(8, 32, v), tenant="flood")
+                for v in (1.0, 2.0, 3.0)]
+        futs.append(eng.submit(_section(8, 32, 7.0), tenant="solo"))
+        deadline = time.monotonic() + 5.0
+        while eng._replicas[0].queue.qsize() < 4:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        gate.release.set()
+        f_head.result(timeout=15)
+        for f in futs:
+            f.result(timeout=15)
+        # execution order (by the marker value in data[0, 1]): the head,
+        # then flood/solo interleaved — solo's request rides second, not
+        # behind the whole flood
+        assert order == [10.0, 1.0, 7.0, 2.0, 3.0]
+    finally:
+        gate.release.set()
+        eng.close()
+
+
+def test_tenant_poison_streak_quarantines_and_release_readmits():
+    """poison_after consecutive poison sheds auto-quarantine the tenant
+    (even healthy submits shed until released); a healthy admission resets
+    the streak, and release_tenant lifts the quarantine."""
+    eng = _mesh_engine(replicas=1, poison_after=2,
+                       health=HealthConfig(enabled=True))
+    rng = np.random.default_rng(7)
+
+    def noisy(poison=False):
+        sec = _section(8, 32)
+        sec.data[:] = rng.standard_normal((8, 32)).astype(np.float32)
+        if poison:
+            sec.data[3, 5:20] = np.nan
+        return sec
+
+    try:
+        # a poison shed then a healthy one: streak resets, no quarantine
+        with pytest.raises(PoisonInputError):
+            eng.submit(noisy(poison=True), tenant="t")
+        eng.submit(noisy(), tenant="t").result(timeout=15)
+        # two consecutive poisons cross the threshold
+        for _ in range(2):
+            with pytest.raises(PoisonInputError):
+                eng.submit(noisy(poison=True), tenant="t")
+        with pytest.raises(TenantQuarantinedError):
+            eng.submit(noisy(), tenant="t")
+        assert eng.metrics()["tenant_table"]["t"]["quarantined"]
+        eng.release_tenant("t")
+        eng.submit(noisy(), tenant="t").result(timeout=15)
+        snap = eng.metrics()
+        assert snap["shed_quarantined"] == 1
+        assert snap["tenants"]["t"]["quarantined"] == 1
+        assert snap["tenants"]["t"]["completed"] == 2
+    finally:
+        eng.close()
+
+
+def test_tenant_drain_under_load():
+    """drain_tenant fails the tenant's queued requests with ShutdownError,
+    waits out its in-flight one, drops its sessions, and leaves every other
+    tenant untouched; new submits shed TenantDrainingError during the
+    drain and re-admit fresh after it."""
+    gate = _MarkGate()
+    eng = _mesh_engine(replicas=1, gate=gate)
+    try:
+        # the draining tenant's in-flight request wedges the worker
+        f_inflight = eng.submit(_wedge_section(), tenant="evict",
+                                session="s-evict")
+        assert gate.started.wait(timeout=10.0)
+        doomed = [eng.submit(_section(8, 32, 2.0), tenant="evict")
+                  for _ in range(2)]
+        f_keep = eng.submit(_section(8, 32, 3.0), tenant="keep")
+        deadline = time.monotonic() + 5.0
+        while eng._replicas[0].queue.qsize() < 3:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        # during the drain new submits shed; release the gate from a timer
+        # so wait_idle can observe the in-flight request complete
+        eng.tenants.start_drain("evict")
+        with pytest.raises(TenantDrainingError):
+            eng.submit(_section(8, 32), tenant="evict")
+        threading.Timer(0.2, gate.release.set).start()
+        summary = eng.drain_tenant("evict", timeout=15.0)
+        assert summary["queued_failed"] == 2 and summary["idle"]
+        for f in doomed:
+            with pytest.raises(ShutdownError):
+                f.result(timeout=1.0)
+        f_inflight.result(timeout=15)            # completed, not killed
+        assert f_keep.result(timeout=15) == 3.0 * 8 * 32
+        assert eng.sessions.sessions_for("evict") == []
+        # the record is gone: the tenant re-admits fresh
+        assert "evict" not in eng.metrics()["tenant_table"]
+        assert eng.submit(_section(8, 32, 4.0),
+                          tenant="evict").result(timeout=15) == 4.0 * 8 * 32
+    finally:
+        gate.release.set()
+        eng.close()
+
+
+def test_replica_drain_under_load_replaces_queued():
+    """drain_replica retires one replica while it is mid-compute: its
+    queued requests re-place onto survivors and complete even before the
+    wedged batch finishes; stickiness re-pins; the drained worker exits
+    once released."""
+    gate = _MarkGate()
+    eng = _mesh_engine(replicas=2, gate=gate)
+    try:
+        # pin session to replica 0 (first least-loaded pick), then wedge it
+        f_wedged = eng.submit(_wedge_section(), session="s", tenant="t")
+        assert gate.started.wait(timeout=10.0)
+        assert eng.policy.sticky_replica("t::s") == 0
+        queued = [eng.submit(_section(8, 32, float(v)), session="s",
+                             tenant="t") for v in (2.0, 3.0)]
+        deadline = time.monotonic() + 5.0
+        while eng._replicas[0].queue.qsize() < 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        eng.drain_replica(0, timeout=0.5)        # worker still wedged: the
+        # queued requests must already be on replica 1 and complete NOW,
+        # while replica 0 is still stuck in its batch
+        assert [f.result(timeout=15) for f in queued] == [
+            2.0 * 8 * 32, 3.0 * 8 * 32]
+        gate.release.set()
+        f_wedged.result(timeout=15)
+        eng._replicas[0].thread.join(timeout=10.0)
+        assert not eng._replicas[0].thread.is_alive()
+        # the session re-pinned onto the survivor
+        assert eng.policy.sticky_replica("t::s") == 1
+        snap = eng.metrics()
+        assert snap["completed"] == 3
+        assert snap["replicas"]["1"]["requests"] == 2
+    finally:
+        gate.release.set()
+        eng.close()
+
+
+def test_mesh_wedged_close_fails_queued_and_releases_quota():
+    """close() with a wedged worker fails still-queued requests with
+    ShutdownError; when the worker unwedges the in-flight member completes
+    and every quota slot has been returned exactly once."""
+    gate = _MarkGate()
+    eng = _mesh_engine(replicas=1, gate=gate)
+    f_wedged = eng.submit(_wedge_section(), tenant="t")
+    assert gate.started.wait(timeout=10.0)
+    f_tail = eng.submit(_section(8, 32, 2.0), tenant="t")
+    deadline = time.monotonic() + 5.0
+    while eng._replicas[0].queue.qsize() < 1:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    eng.close(timeout=0.2)
+    with pytest.raises(ShutdownError):
+        f_tail.result(timeout=1.0)
+    gate.release.set()
+    assert f_wedged.result(timeout=15) == float(
+        np.asarray(_wedge_section().data).sum())
+    eng._replicas[0].thread.join(timeout=10.0)
+    snap = eng.metrics()
+    assert snap["completed"] == 1
+    assert snap["tenant_table"]["t"]["admitted"] == 0
+
+
+# --------------------------------------------------------------------------
+# ring placement: bit-exactness vs the single-device engine
+# --------------------------------------------------------------------------
+
+@pytest.mark.parallel
+def test_ring_placement_bit_exact_vs_single_device_engine():
+    """A large-geometry request served through the mesh engine's ring
+    placement returns the bit-identical peak matrix the single-device
+    engine computes — on the kernel path (use_pallas=True, interpret on
+    CPU) the sharded program evaluates the same FP ops per pair (the PR 4
+    invariant, here re-pinned THROUGH both serving stacks)."""
+    from das_diff_veh_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((26, 512)).astype(np.float32)
+    sec = DasSection(data, np.arange(26, dtype=np.float64),
+                     np.arange(512, dtype=np.float64) / 250.0)
+    kw = dict(wlen=128, src_chunk=4, use_pallas=True, interpret=True)
+    bucket = ((26, 512),)
+
+    single = ServingEngine(
+        AllPairsComputeFactory(**kw),
+        ServeConfig(buckets=bucket, default_deadline_ms=600000.0)).start()
+    mesh_eng = MeshServingEngine(
+        AllPairsComputeFactory(mesh=make_mesh(8), **kw),
+        MeshServeConfig(
+            serve=ServeConfig(buckets=bucket, default_deadline_ms=600000.0),
+            replicas=1, ring_min_channels=20)).start()
+    try:
+        ref = single.submit(sec).result(timeout=120)
+        out = mesh_eng.submit(sec).result(timeout=120)
+        assert ref.placement == "single" and out.placement == "ring"
+        assert out.peaks.shape == (26, 26)
+        np.testing.assert_array_equal(out.peaks, ref.peaks)
+        snap = mesh_eng.metrics()
+        assert snap["placements"] == {"ring:0": 1}
+        assert snap["cache_misses"] == 0
+        # ring + the one replica were both warmed
+        assert snap["warmup_builds"] == 2
+        assert snap["mesh"]["ring"] and snap["mesh"]["ring_devices"] == 8
+    finally:
+        single.close()
+        mesh_eng.close()
+
+
+# --------------------------------------------------------------------------
+# HTTP front: tenant field, 429 mapping, merged metrics exposition
+# --------------------------------------------------------------------------
+
+def _post(base, path, payload):
+    req = urllib.request.Request(base + path, json.dumps(payload).encode(),
+                                 {"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_http_tenant_quota_429_and_merged_metrics_views():
+    """POST /v1/process carries the tenant; an over-quota submit maps to a
+    structured 429; /v1/metrics and /metrics expose the per-tenant and
+    per-replica views in the SAME exposition as the base families — no
+    second scrape endpoint."""
+    gate = _MarkGate()
+    eng = _mesh_engine(replicas=1, gate=gate, quota=1)
+    server, _ = serve_in_thread(eng)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        f_wedged = eng.submit(_wedge_section(), tenant="cap")
+        assert gate.started.wait(timeout=10.0)
+        code, body = _post(base, "/v1/process",
+                           {"data": _section(8, 32).data.tolist(),
+                            "tenant": "cap"})
+        assert code == 429
+        assert body["cause"] == "quota" and body["tenant"] == "cap"
+        gate.release.set()
+        f_wedged.result(timeout=15)
+        code, body = _post(base, "/v1/process",
+                           {"data": _section(8, 32, 2.0).data.tolist(),
+                            "tenant": "cap"})
+        assert code == 200
+        with urllib.request.urlopen(base + "/v1/metrics", timeout=15) as r:
+            snap = json.loads(r.read())
+        assert snap["tenants"]["cap"]["shed_quota"] == 1
+        assert snap["tenants"]["cap"]["completed"] == 2
+        assert "replicas" in snap and "placements" in snap
+        assert "tenant_table" in snap
+        with urllib.request.urlopen(base + "/metrics", timeout=15) as r:
+            text = r.read().decode()
+        # one exposition: base families AND the mesh families
+        assert "das_serve_events_total" in text
+        assert 'das_serve_placements_total{placement="replica:0"}' in text
+        assert 'das_serve_tenant_events_total{tenant="cap"' in text
+        assert 'das_serve_replica_queue_depth{replica="0"}' in text
+    finally:
+        gate.release.set()
+        server.shutdown()
+        eng.close()
