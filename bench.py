@@ -56,10 +56,19 @@ pipeline entry (``stage_pipeline_fused_*`` keys) times the full per-chunk
 pipeline staged vs fused (``cfg.chunk_pipeline="fused"``: one donated XLA
 program per chunk, pipeline/fused.py) and commits the dispatch
 accounting — staged programs-per-chunk N vs fused 1 dispatch/chunk with
-zero steady-state traces; BENCH_FUSED_DURATION/REPS tune it.  Opt-outs:
+zero steady-state traces; BENCH_FUSED_DURATION/REPS tune it.  A tuner entry
+(``tune_*`` keys) runs a default-vs-tuned knob-sweep A/B through the real
+``das_diff_veh_tpu.tune`` API (store round-trip + hit proven), and a
+precision entry (``precision_*`` keys) A/Bs the dispersion transform at
+f32 vs bf16 (the rel-err is the portable evidence; the throughput delta is
+TPU-only).  Both are *selectable*: ``bench.py --json-only tune precision``
+runs just those entries and prints one ``bench_subset`` JSON line — the
+tuner and CI path that skips the full smoke sweep.  Opt-outs:
 BENCH_SKIP_E2E / BENCH_SKIP_OBS / BENCH_SKIP_CHAOS / BENCH_SKIP_SERVE / BENCH_SKIP_SERVE_MESH / BENCH_SKIP_PALLAS / BENCH_SKIP_SHARDED /
-BENCH_SKIP_LONG / BENCH_SKIP_10K / BENCH_SKIP_FUSED; BENCH_10K_SRC_CHUNK tunes the 10k
+BENCH_SKIP_LONG / BENCH_SKIP_10K / BENCH_SKIP_FUSED / BENCH_SKIP_TUNE /
+BENCH_SKIP_PRECISION; BENCH_10K_SRC_CHUNK tunes the 10k
 source-chunk size (default 32 — see docs/PERF.md on the working-set effect).
+The full env-knob table lives in docs/PERF.md §"Bench env knobs".
 
 Prints ONE JSON line with the primary metric plus an ``extra`` dict:
   {"metric": "vsg_disp_700m_build", "value": <s>, "unit": "s",
@@ -83,6 +92,143 @@ import time
 import numpy as np
 
 N_WINDOWS = 60
+
+
+# --- selectable entries (bench.py --json-only <keys>) ------------------------
+# Each entry is a standalone callable(extra) that fills its own keys and may
+# raise — the caller fault-isolates to <name>_error like every other group.
+# Legacy groups embedded in main() are *skipped* (not selected) via the
+# BENCH_SKIP_* env knobs documented in docs/PERF.md; new modular entries
+# register here so the tuner and CI can run one entry without paying the
+# full smoke sweep.
+
+def _bench_tune(extra: dict) -> None:
+    """Default-vs-tuned A/B through the real tuner API (``tune_*`` keys).
+
+    Sweeps ``ring.win_block`` for the einsum all-pairs peak on a small
+    record with ``das_diff_veh_tpu.tune.tune`` (greedy sweep + store
+    round-trip), then proves the persisted entry is a store *hit* on the
+    second call.  Runs on any backend; on this CPU smoke rig the timings
+    are CPU evidence only — the sweep mechanics and persistence are the
+    committed result, the speedup is rig-specific.
+    """
+    import tempfile
+
+    import jax
+
+    from das_diff_veh_tpu.config import PipelineConfig, RingConfig
+    from das_diff_veh_tpu.ops.pallas_xcorr import xcorr_all_pairs_peak
+    from das_diff_veh_tpu.tune import KnobSpec, TunerStore, tune
+
+    nch, nt, wlen = 48, 2048, 128
+    rng = np.random.default_rng(7)
+    import jax.numpy as jnp
+    data = jnp.asarray(rng.standard_normal((nch, nt)).astype(np.float32))
+    iters = max(2, int(os.environ.get("BENCH_TUNE_ITERS", 4)))
+
+    def time_fn(cfg, ring):
+        wb = ring.win_block
+
+        def run():
+            return xcorr_all_pairs_peak(data, wlen, use_pallas=False,
+                                        win_block=wb).block_until_ready()
+
+        run()                              # compile + warm outside the clock
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            run()
+        return (time.perf_counter() - t0) / iters
+
+    backend = jax.default_backend()
+    knobs = [KnobSpec("ring.win_block", (8, 16, 32, 64))]
+    with tempfile.TemporaryDirectory() as d:
+        store = TunerStore(os.path.join(d, "tuner.json"))
+        _, ring, entry = tune(store, backend, "bench_smoke",
+                              PipelineConfig(), knobs, time_fn,
+                              reps=2, ring=RingConfig())
+        # second consult must hit the persisted entry (no re-sweep)
+        _, _, entry2 = tune(store, backend, "bench_smoke",
+                            PipelineConfig(), [], time_fn,
+                            reps=1, ring=RingConfig())
+    extra["tune_backend"] = backend
+    extra["tune_default_s"] = round(entry.meta["baseline_s"], 5)
+    extra["tune_tuned_s"] = round(entry.meta["tuned_s"], 5)
+    extra["tune_speedup"] = round(entry.meta["speedup"], 3)
+    extra["tune_winners"] = {k: repr(v) for k, v in entry.winners.items()}
+    extra["tune_store_hit"] = entry2.winners == entry.winners
+
+
+def _bench_precision(extra: dict) -> None:
+    """f32-vs-bf16 A/B on the dispersion transform (``precision_*`` keys).
+
+    Times ``fv_map_fk`` at both tiers on one jitted program each and
+    records the relative error.  On CPU the bf16 tier only pays its
+    rounding casts (no bf16 MXU exists to win on), so the committed
+    evidence here is the error bound; the throughput delta is meaningful
+    on TPU hardware only and is disclosed as such.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from das_diff_veh_tpu.config import DispersionConfig
+    from das_diff_veh_tpu.ops.dispersion import fv_map_fk
+
+    dcfg = DispersionConfig()
+    rng = np.random.default_rng(11)
+    data = jnp.asarray(rng.standard_normal((64, 2048)).astype(np.float32))
+    freqs = jnp.arange(dcfg.freq_min, dcfg.freq_max, dcfg.freq_step)
+    vels = jnp.arange(dcfg.vel_min, dcfg.vel_max, dcfg.vel_step)
+    iters = max(2, int(os.environ.get("BENCH_PRECISION_ITERS", 4)))
+
+    def timed(precision):
+        f = jax.jit(lambda d: fv_map_fk(d, 8.16, 0.004, freqs, vels,
+                                        precision=precision))
+        out = f(data).block_until_ready()       # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            f(data).block_until_ready()
+        return (time.perf_counter() - t0) / iters, out
+
+    t32, img32 = timed("f32")
+    t16, img16 = timed("bf16")
+    rel = float(jnp.max(jnp.abs(img32 - img16)) / jnp.max(jnp.abs(img32)))
+    extra["precision_f32_s"] = round(t32, 5)
+    extra["precision_bf16_s"] = round(t16, 5)
+    extra["precision_speedup"] = round(t32 / t16, 3)
+    extra["precision_rel_err"] = round(rel, 6)
+    extra["precision_note"] = (
+        "bf16 throughput delta is TPU-MXU-only; on CPU the tier pays its "
+        "rounding casts for free accuracy evidence (rel_err is the "
+        "portable number, bound committed in tests/test_precision.py)")
+
+
+ENTRIES = {
+    "tune": _bench_tune,
+    "precision": _bench_precision,
+}
+
+
+def run_json_only(keys) -> int:
+    """Run only the named registry entries; print ONE JSON line."""
+    from das_diff_veh_tpu.cache import enable_compilation_cache
+
+    enable_compilation_cache(os.path.dirname(os.path.abspath(__file__)))
+    extra: dict = {}
+    n_ok = 0
+    for k in keys:
+        fn = ENTRIES.get(k)
+        if fn is None:
+            extra[f"{k}_error"] = (f"KeyError: unknown bench entry {k!r}; "
+                                   f"selectable: {sorted(ENTRIES)}")
+            continue
+        try:
+            fn(extra)
+            n_ok += 1
+        except Exception as e:
+            extra[f"{k}_error"] = f"{type(e).__name__}: {e}"[:300]
+    print(json.dumps({"metric": "bench_subset", "value": n_ok,
+                      "unit": "entries", "extra": extra}))
+    return 0
 
 
 def main() -> None:
@@ -713,7 +859,7 @@ def main() -> None:
             eng = ServingEngine(
                 FnComputeFactory(serve_build, "bench_serve"),
                 ServeConfig(buckets=((s_nch, s_nt),), max_batch=4,
-                            max_queue=max(n_reqs, 8), batch_window_ms=2.0,
+                            max_queue=max(n_reqs, 8),
                             default_deadline_ms=600000.0)).start()
             futures = []
             t_start = time.perf_counter()
@@ -997,6 +1143,15 @@ def main() -> None:
                     extra["replicated_vs_ring_peak_bytes_ratio"] = round(
                         repl_peak / max(ring_peak, 1), 3)
 
+    # --- modular entries (also selectable via --json-only) -------------------
+    for name, entry_fn in ENTRIES.items():
+        if os.environ.get(f"BENCH_SKIP_{name.upper()}"):
+            continue
+        try:
+            entry_fn(extra)
+        except Exception as e:
+            extra[f"{name}_error"] = f"{type(e).__name__}: {e}"[:300]
+
     assert bool(jnp.isfinite(img).all()), "benchmark produced non-finite image"
     # primary = per-build device time amortized over K in-dispatch builds:
     # the number a non-tunneled deployment sees.  The per-dispatch latency on
@@ -1014,4 +1169,11 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    argv = sys.argv[1:]
+    if argv and argv[0] == "--json-only":
+        if not argv[1:]:
+            print(f"usage: bench.py --json-only <key> [...]; "
+                  f"selectable: {sorted(ENTRIES)}", file=sys.stderr)
+            sys.exit(2)
+        sys.exit(run_json_only(argv[1:]))
     sys.exit(main())

@@ -157,10 +157,37 @@ class GatherConfig:
     correlate finishes outside — numerically the serialized path with the
     cut swapped out.  ``"dot"``: the circular correlation finishes
     in-kernel as an MXU dot against the doubled source-window matrix
-    (small windows only: ``wlen <= ops.pallas_gather.DOT_MAX_WLEN`` and
-    ``nwin*wlen^2 <= DOT_MAX_MATRIX_ELEMS``, the joint VMEM budget of the
+    (small windows only: ``wlen <= dot_max_wlen`` and
+    ``nwin*wlen^2 <= dot_max_matrix_elems``, the joint VMEM budget of the
     in-kernel matrix; time-domain float rounding applies, see tests for
     the pinned tolerance)."""
+
+    fused_max_nwin: int = 64
+    """Per-kernel-step unroll cap of the fused gather (the former
+    ``ops.pallas_gather.FUSED_MAX_NWIN`` module constant, hoisted so the
+    tuner can sweep it per backend/geometry — docs/TUNING.md).  Shapes with
+    more windows than this take the serialized path under
+    ``traj_gather="auto"``.  Execution knob: participates in the runtime
+    config hash via the dataclass repr."""
+
+    dot_max_wlen: int = 256
+    """VMEM budget cap on the window length admitted to the in-kernel
+    ``"dot"`` finish (former ``DOT_MAX_WLEN`` constant; tunable knob)."""
+
+    dot_max_matrix_elems: int = 1 << 20
+    """Joint VMEM budget cap ``nwin * wlen^2`` of the in-kernel doubled
+    source matrix for the ``"dot"`` finish (former ``DOT_MAX_MATRIX_ELEMS``
+    constant; tunable knob)."""
+
+    precision: str = "f32"
+    """MXU precision tier of the fused gather's ``"dot"`` finish.
+    ``"f32"`` (default): full float32 operands, HIGHEST precision — the
+    parity tier, bit-identical to the pre-tier behavior.  ``"bf16"``:
+    bfloat16 operands with float32 accumulation
+    (``preferred_element_type``) — trades last-digit parity for MXU
+    throughput under the per-stage error budget committed in
+    tests/test_precision.py and disclosed in docs/TUNING.md.  NOT swept by
+    the tuner (accuracy is an operator decision, not a timing winner)."""
 
 
 @dataclass(frozen=True)
@@ -185,6 +212,16 @@ class DispersionConfig:
     # reference problem size, "fk" is the faster of the two (bench.py
     # stage_disp_image_* keys) as well as the parity path.
     method: str = "fk"
+
+    precision: str = "f32"
+    """Precision tier of the slant-stack contractions (``ops.dispersion``).
+    ``"f32"`` (default): HIGHEST-precision float32 — the parity tier,
+    bit-identical to the pre-tier behavior.  ``"bf16"``: bfloat16 operands
+    into the f-k bilinear-sampling matmuls / phase-shift steering einsum
+    with float32 accumulation; error budget committed in
+    tests/test_precision.py and disclosed in docs/TUNING.md.  Unlike
+    ``method`` this is an execution tier — but it DOES move last digits,
+    so it is an explicit operator opt-in and the tuner never sweeps it."""
 
     @property
     def n_freqs(self) -> int:
@@ -261,6 +298,28 @@ class RingConfig:
     finish (``ops.pallas_xcorr.peak_from_spectra``).  None = fuse on the
     kernel path with the default block; 0 = unfused XLA finish; >0 = that
     block size."""
+
+    win_block: Optional[int] = None
+    """Windows per correlation-kernel grid step
+    (``ops.pallas_xcorr`` spectra-tile kernel; also batches the einsum
+    fallback).  None = the auto heuristic (stream long records in blocks,
+    single pass otherwise); >0 pins that block size.  Hoisted into config
+    so the tuner can sweep it per backend/geometry (docs/TUNING.md);
+    participates in the runtime config hash via the dataclass repr."""
+
+    lag_tile_max: int = 512
+    """Upper bound of the lag-axis tile auto-sizing in the fused Pallas
+    lag-max finish (former ``ops.pallas_xcorr._PEAK_TILE_L`` constant;
+    tunable knob).  The tile grows by doubling from the 128-lane floor
+    while it divides the padded lag span, capped here."""
+
+    precision: str = "f32"
+    """Precision tier of the ring correlation.  ``"f32"`` (default): full
+    float32 spectra planes, HIGHEST-precision einsum fallback — the parity
+    tier, bit-identical to the pre-tier behavior.  ``"bf16"``: bfloat16
+    planar spectra with float32 accumulation — halves the HBM/VMEM
+    footprint of the receiver planes the ring rotates; error budget
+    committed in tests/test_precision.py.  Not swept by the tuner."""
 
 
 @dataclass(frozen=True)
@@ -419,7 +478,8 @@ class ServeConfig:
     arriving while a same-bucket batch is executing is admitted into the
     open batch slot at the next member boundary, so an idle engine pays
     zero added latency and a busy engine still coalesces.  The field is
-    kept so existing configs/CLI invocations keep parsing."""
+    kept so existing configs/CLI invocations keep parsing; setting it to
+    a non-default value emits a ``DeprecationWarning``."""
 
     default_deadline_ms: float = 30000.0
     """Deadline applied to requests that do not pass one.  A request still
@@ -452,6 +512,15 @@ class ServeConfig:
     floods — as :class:`~das_diff_veh_tpu.serve.engine.PoisonInputError`
     (HTTP 422) before they can join a microbatch, so one corrupt request
     never contaminates a cohort.  None disables the screen entirely."""
+
+    def __post_init__(self) -> None:
+        if self.batch_window_ms != 2.0:
+            import warnings
+            warnings.warn(
+                "ServeConfig.batch_window_ms is deprecated and ignored: "
+                "the dispatcher batches continuously (iteration-level) "
+                "instead of lingering for companions.  Drop the argument.",
+                DeprecationWarning, stacklevel=3)
 
 
 @dataclass(frozen=True)

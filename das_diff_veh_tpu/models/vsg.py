@@ -124,7 +124,11 @@ def build_gather(data: jnp.ndarray, t_axis: jnp.ndarray, x_axis: jnp.ndarray,
     far = xc.xcorr_traj_follow(d, t_axis, g.pivot_idx, far_ch, far_t,
                                g.nsamp, g.wlen, cfg.overlap_ratio,
                                mode=cfg.traj_gather,
-                               finish=cfg.traj_gather_finish)
+                               finish=cfg.traj_gather_finish,
+                               max_nwin=cfg.fused_max_nwin,
+                               dot_max_wlen=cfg.dot_max_wlen,
+                               dot_max_elems=cfg.dot_max_matrix_elems,
+                               precision=cfg.precision)
     main = _postprocess(jnp.concatenate([near, far], axis=0), g,
                         cfg.norm, cfg.norm_amp, reverse=False)
     if not cfg.include_other_side:
@@ -141,7 +145,11 @@ def build_gather(data: jnp.ndarray, t_axis: jnp.ndarray, x_axis: jnp.ndarray,
     left = xc.xcorr_traj_follow(d, t_axis, g.pivot_idx, left_ch, left_t,
                                 g.nsamp, g.wlen, cfg.overlap_ratio,
                                 reverse=True, mode=cfg.traj_gather,
-                                finish=cfg.traj_gather_finish)
+                                finish=cfg.traj_gather_finish,
+                                max_nwin=cfg.fused_max_nwin,
+                                dot_max_wlen=cfg.dot_max_wlen,
+                                dot_max_elems=cfg.dot_max_matrix_elems,
+                                precision=cfg.precision)
     other = _postprocess(jnp.concatenate([left, right], axis=0), g,
                          cfg.norm, cfg.norm_amp, reverse=True)
 
@@ -191,10 +199,12 @@ def gather_disp_image(xcf: jnp.ndarray, offsets: np.ndarray, dt: float,
     sliced = xcf[..., sxi:exi + 1, :]
     if cfg.method == "phase_shift":
         img = fv_map_phase_shift(sliced, dx, dt, freqs, vels,
-                                 direction=-1.0, whiten=False)
+                                 direction=-1.0, whiten=False,
+                                 precision=cfg.precision)
     else:
         img = fv_map_fk(sliced, dx, dt, freqs, vels, norm=cfg.norm,
-                        sg_window=cfg.sg_window, sg_order=cfg.sg_order)
+                        sg_window=cfg.sg_window, sg_order=cfg.sg_order,
+                        precision=cfg.precision)
     if enhance:
         from das_diff_veh_tpu.ops.enhance import fv_map_enhance
         img = fv_map_enhance(img)

@@ -81,9 +81,27 @@ def _hat(centers: jnp.ndarray, u: jnp.ndarray) -> jnp.ndarray:
     return jnp.maximum(0.0, 1.0 - jnp.abs(centers - u))
 
 
+def _bf16_round(x: jnp.ndarray) -> jnp.ndarray:
+    """Round a real array through bfloat16 (bf16-valued float32): the input
+    side of the ``"bf16"`` precision tier.  Paired with DEFAULT-precision
+    contractions it yields bf16 operands + f32 accumulation on the TPU MXU;
+    off-TPU the contraction is exact on the bf16-rounded inputs, so the
+    committed error bounds measure the same input-rounding semantics."""
+    return x.astype(jnp.bfloat16).astype(jnp.float32)
+
+
+def _contraction_precision(precision: str):
+    if precision not in ("f32", "bf16"):
+        raise ValueError(
+            f"precision must be 'f32' or 'bf16', got {precision!r}")
+    return (jax.lax.Precision.DEFAULT if precision == "bf16"
+            else jax.lax.Precision.HIGHEST)
+
+
 def fv_map_fk(data: jnp.ndarray, dx: float, dt: float, freqs: jnp.ndarray,
               vels: jnp.ndarray, norm: bool = False,
-              sg_window: int = 25, sg_order: int = 4) -> jnp.ndarray:
+              sg_window: int = 25, sg_order: int = 4,
+              precision: str = "f32") -> jnp.ndarray:
     """Reference-parity dispersion map (``map_fv``, modules/utils.py:457-475).
 
     Returns (nvel, nfreq).  ``norm`` applies the per-trace L1 normalization
@@ -96,7 +114,14 @@ def fv_map_fk(data: jnp.ndarray, dx: float, dt: float, freqs: jnp.ndarray,
     contract against on-the-fly hat weights.  Identical math to clamped
     bilinear (tested), but it runs on the MXU — the gather formulation was
     ~10 ms of the benchmark pipeline on the v5e, the contraction is ~none.
+
+    ``precision="bf16"`` (``DispersionConfig.precision``) rounds the
+    f-k magnitude and hat weights through bfloat16 and contracts at
+    DEFAULT precision — bf16 MXU passes with f32 accumulation; the
+    default ``"f32"`` keeps the HIGHEST-precision path bit-identical to
+    the pre-tier behavior (tests/test_precision.py pins the bf16 budget).
     """
+    xla_prec = _contraction_precision(precision)
     if norm:
         data = data / jnp.linalg.norm(data, axis=-1, keepdims=True, ord=1)
     fk_mag, f_axis, k_axis = fk_transform(data, dx, dt)
@@ -106,15 +131,21 @@ def fv_map_fk(data: jnp.ndarray, dx: float, dt: float, freqs: jnp.ndarray,
     fr = jnp.asarray(freqs)
     vl = jnp.asarray(vels)
     nk, nf = fk_mag.shape
+    if precision == "bf16":
+        fk_mag = _bf16_round(fk_mag.astype(jnp.float32))
     # f-direction: one clamped position per output column
     uf = jnp.clip((fr - f0) / df, 0.0, nf - 1.0)          # (nfreq,)
     Wf = _hat(jnp.arange(nf)[:, None], uf[None, :])       # (nf_pad, nfreq)
-    colmix = jnp.matmul(fk_mag, Wf, precision=jax.lax.Precision.HIGHEST)
+    if precision == "bf16":
+        Wf = _bf16_round(Wf.astype(jnp.float32))
+    colmix = jnp.matmul(fk_mag, Wf, precision=xla_prec)
     # k-direction: per-(v, f) clamped position k = f/v
     uk = jnp.clip((fr[None, :] / vl[:, None] - k0) / dk, 0.0, nk - 1.0)
     Wk = _hat(jnp.arange(nk)[None, None, :], uk[..., None])  # (nvel, nfreq, nk)
+    if precision == "bf16":
+        Wk = _bf16_round(Wk.astype(jnp.float32))
     vals = jnp.einsum("vfk,kf->vf", Wk, colmix,
-                      precision=jax.lax.Precision.HIGHEST)   # (nvel, nfreq)
+                      precision=xla_prec)                    # (nvel, nfreq)
     smoothed = savgol_filter(vals, sg_window, sg_order, axis=-1)  # over frequency
     return smoothed
 
@@ -122,7 +153,8 @@ def fv_map_fk(data: jnp.ndarray, dx: float, dt: float, freqs: jnp.ndarray,
 def fv_map_phase_shift(data: jnp.ndarray, dx: float, dt: float, freqs: jnp.ndarray,
                        vels: jnp.ndarray, whiten: bool = True,
                        x0: float = 0.0, direction: float = 1.0,
-                       vel_chunk: int = 128) -> jnp.ndarray:
+                       vel_chunk: int = 128,
+                       precision: str = "f32") -> jnp.ndarray:
     """Phase-shift (frequency-domain slant stack) dispersion map.
 
     P(v, f) = | Σ_x U(x, f) e^{i·direction·2π f (x - x0) / v} |, with optional
@@ -133,7 +165,23 @@ def fv_map_phase_shift(data: jnp.ndarray, dx: float, dt: float, freqs: jnp.ndarr
     source at 0, i.e. direction=-1 in slice coordinates).  Velocity axis is
     processed in chunks to bound the steering-tensor footprint.
     Returns (nvel, nfreq).
+
+    ``precision="bf16"`` rounds the sampled spectrum and steering tensor's
+    real/imag planes through bfloat16 (the contraction precision is left at
+    the platform default either way — this path was never forced to
+    HIGHEST, and forcing it for f32 would silently change the compiled
+    program); ``"f32"`` (default) is bit-identical to the pre-tier
+    behavior.
     """
+    _contraction_precision(precision)      # validate the tier name
+
+    def _round_c(z):
+        if precision != "bf16":
+            return z
+        z = z.astype(jnp.complex64)
+        return (_bf16_round(z.real) + 1j * _bf16_round(z.imag)
+                ).astype(jnp.complex64)
+
     nch, nt = data.shape[-2], data.shape[-1]
     spec = jnp.fft.rfft(data, axis=-1)                  # (nch, nfr)
     fft_freqs = jnp.fft.rfftfreq(nt, d=dt)
@@ -144,14 +192,14 @@ def fv_map_phase_shift(data: jnp.ndarray, dx: float, dt: float, freqs: jnp.ndarr
     # nearest-bin pick in map_fv_FD_slant_stack modules/utils.py:451)
     fbin = jnp.clip(jnp.round(jnp.asarray(freqs) * nt * dt).astype(jnp.int32),
                     0, fft_freqs.shape[0] - 1)
-    u = spec[:, fbin]                                   # (nch, nfreq)
+    u = _round_c(spec[:, fbin])                         # (nch, nfreq)
     x = (jnp.arange(nch) * dx - x0)
     fr = jnp.asarray(freqs)
 
     def chunk(vc):
         # steering: (nvc, nfreq, nch)
         phase = 2.0 * jnp.pi * fr[None, :, None] * x[None, None, :] / vc[:, None, None]
-        steer = jnp.exp(1j * direction * phase)
+        steer = _round_c(jnp.exp(1j * direction * phase))
         return jnp.abs(jnp.einsum("xf,vfx->vf", u, steer))
 
     vl = jnp.asarray(vels)

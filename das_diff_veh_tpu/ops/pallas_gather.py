@@ -62,7 +62,11 @@ from jax.experimental.pallas import tpu as pltpu
 # gathers cut ~3-15 windows (nwin = (nsamp - wlen)//offset + 1); past this
 # the unrolled in-kernel cut would bloat the kernel body, so ``mode="auto"``
 # falls back to the serialized path (continuous-record window counts belong
-# to the all-pairs engine, not the per-vehicle gather).
+# to the all-pairs engine, not the per-vehicle gather).  These module values
+# are the DEFAULTS of the corresponding ``GatherConfig`` fields
+# (``fused_max_nwin`` / ``dot_max_wlen`` / ``dot_max_matrix_elems``), which
+# the tuner sweeps per backend/geometry (docs/TUNING.md); every entry point
+# below takes the caps as optional arguments defaulting to these.
 FUSED_MAX_NWIN = 64
 
 # The "dot" finish materializes the (nwin, wlen, wlen) doubled-window
@@ -132,13 +136,19 @@ def _pack_kernel(nwin: int, wlen: int, offset: int,
 
 
 def _dot_kernel(nwin: int, wlen: int, offset: int, swap: bool,
+                precision: str,
                 sref, ch_lo, ch_hi, pv_lo, pv_hi, out):
     """Fully fused step: cut both traces' windows AND finish the circular
     correlation in-kernel as an MXU dot against the doubled source-window
     matrix.  ``c[w, k] = sum_n s2[w, n+k] * r[w, n]`` with ``s2 = [s, s]``
     is exactly the reference's doubled-source "valid" correlate; the masked
     window mean and the zero-lag centering roll happen here too, so the
-    output block is the final (1, wlen_pad) correlation row."""
+    output block is the final (1, wlen_pad) correlation row.
+
+    ``precision="bf16"`` feeds the MXU bfloat16 operands with float32
+    accumulation (``preferred_element_type``) — the Micikevicius-style
+    mixed-precision tier; ``"f32"`` keeps the HIGHEST-precision full-width
+    contraction bit-identical to the pre-tier kernel."""
     k = pl.program_id(0)
     rem, avail = sref[0, k], sref[1, k]
     row_ch, row_pv = _rows(ch_lo, ch_hi, pv_lo, pv_hi)
@@ -147,11 +157,18 @@ def _dot_kernel(nwin: int, wlen: int, offset: int, swap: bool,
     src, rcv = (wins_pv, wins_ch) if swap else (wins_ch, wins_pv)
     s2 = jnp.concatenate([src, src], axis=1)             # (nwin, 2*wlen)
     # doubled-window matrix D[w, k, :] = s2[w, k:k+wlen]: wlen STATIC
-    # slices (bounded by DOT_MAX_WLEN), then one batched MXU contraction
+    # slices (bounded by dot_max_wlen), then one batched MXU contraction
     dmat = jnp.stack([s2[:, j:j + wlen] for j in range(wlen)], axis=1)
-    c = lax.dot_general(dmat, rcv, (((2,), (1,)), ((0,), (0,))),
-                        precision=lax.Precision.HIGHEST,
-                        preferred_element_type=rcv.dtype)  # (nwin, wlen)
+    if precision == "bf16":
+        c = lax.dot_general(dmat.astype(jnp.bfloat16),
+                            rcv.astype(jnp.bfloat16),
+                            (((2,), (1,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32,
+                            ).astype(rcv.dtype)          # (nwin, wlen)
+    else:
+        c = lax.dot_general(dmat, rcv, (((2,), (1,)), ((0,), (0,))),
+                            precision=lax.Precision.HIGHEST,
+                            preferred_element_type=rcv.dtype)  # (nwin, wlen)
     n_eff = jnp.sum(ok.astype(c.dtype))
     row = jnp.sum(c, axis=0) / jnp.maximum(n_eff, 1)
     row = jnp.roll(row, wlen // 2)                       # zero lag -> wlen//2
@@ -243,7 +260,8 @@ def traj_follow_windows(data: jnp.ndarray, pivot_idx,
                         ch_indices: jnp.ndarray, dt_idx: jnp.ndarray,
                         nsamp: int, wlen: int, offset: int,
                         backward: bool = False,
-                        interpret: bool | None = None):
+                        interpret: bool | None = None,
+                        max_nwin: int | None = None):
     """Fused window cut: packed ``(nk, nwin, wlen)`` channel and pivot
     window tensors, one kernel sweep over the ``nk`` output channels
     (invalid windows zeroed, ``n_eff`` per channel returned).
@@ -253,7 +271,7 @@ def traj_follow_windows(data: jnp.ndarray, pivot_idx,
     bit-identical to the serialized cut's.
     """
     nwin = (nsamp - wlen) // offset + 1
-    _check_fused(nwin, wlen, None)
+    _check_fused(nwin, wlen, None, max_nwin=max_nwin)
     if ch_indices.shape[0] == 0:
         z = jnp.zeros((0, nwin, wlen), data.dtype)
         return z, z, jnp.zeros((0,), jnp.int32)
@@ -274,51 +292,81 @@ def traj_follow_correlate_dot(data: jnp.ndarray, pivot_idx,
                               ch_indices: jnp.ndarray, dt_idx: jnp.ndarray,
                               nsamp: int, wlen: int, offset: int,
                               backward: bool = False, swap: bool = False,
-                              interpret: bool | None = None) -> jnp.ndarray:
+                              interpret: bool | None = None,
+                              max_nwin: int | None = None,
+                              dot_max_wlen: int | None = None,
+                              dot_max_elems: int | None = None,
+                              precision: str = "f32") -> jnp.ndarray:
     """Fully fused gather+correlate ("(b)" finish): the kernel cuts both
     traces' windows AND finishes the circular correlation as an in-kernel
     MXU dot — returns the final rolled ``(nk, wlen)`` correlation rows.
     ``swap=True`` correlates (src=pivot, rcv=channel), the reverse-side
-    operand order of ``xcorr_traj_follow``."""
+    operand order of ``xcorr_traj_follow``.  ``precision="bf16"`` runs the
+    in-kernel contraction on bfloat16 operands with f32 accumulation
+    (``GatherConfig.precision``; tests/test_precision.py pins the error
+    budget)."""
     nwin = (nsamp - wlen) // offset + 1
-    _check_fused(nwin, wlen, "dot")
+    _check_fused(nwin, wlen, "dot", max_nwin=max_nwin,
+                 dot_max_wlen=dot_max_wlen, dot_max_elems=dot_max_elems)
     if ch_indices.shape[0] == 0:
         return jnp.zeros((0, wlen), data.dtype)
     wp = _round_up(wlen, _LANE)
     out, _, _ = _fused_call(
         data, pivot_idx, ch_indices, dt_idx, nsamp, wlen, backward,
         interpret,
-        kernel=partial(_dot_kernel, nwin, wlen, offset, swap),
+        kernel=partial(_dot_kernel, nwin, wlen, offset, swap, precision),
         out_specs=pl.BlockSpec((1, wp), lambda k, s: (k, 0)),
         out_shape_fn=lambda nk, wlen_pad, dt: jax.ShapeDtypeStruct(
             (nk, wlen_pad), dt))
     return out[:, :wlen]
 
 
-def _check_fused(nwin: int, wlen: int, finish: str | None) -> None:
+def _resolve_caps(max_nwin: int | None, dot_max_wlen: int | None,
+                  dot_max_elems: int | None) -> tuple[int, int, int]:
+    """Fill unset caps with the module defaults (= the ``GatherConfig``
+    field defaults, the tuner's sweep baseline)."""
+    return (FUSED_MAX_NWIN if max_nwin is None else int(max_nwin),
+            DOT_MAX_WLEN if dot_max_wlen is None else int(dot_max_wlen),
+            DOT_MAX_MATRIX_ELEMS if dot_max_elems is None
+            else int(dot_max_elems))
+
+
+def _check_fused(nwin: int, wlen: int, finish: str | None,
+                 max_nwin: int | None = None,
+                 dot_max_wlen: int | None = None,
+                 dot_max_elems: int | None = None) -> None:
+    cap_nwin, cap_wlen, cap_elems = _resolve_caps(max_nwin, dot_max_wlen,
+                                                  dot_max_elems)
     if nwin < 1:
         raise ValueError(
             f"fused gather needs at least one window (nwin={nwin}: "
             f"nsamp < wlen?)")
-    if nwin > FUSED_MAX_NWIN:
+    if nwin > cap_nwin:
         raise ValueError(
             f"fused gather unrolls nwin={nwin} window cuts per grid step; "
-            f"past FUSED_MAX_NWIN={FUSED_MAX_NWIN} use the serialized path "
+            f"past fused_max_nwin={cap_nwin} use the serialized path "
             f"(traj_gather='serialized')")
-    if finish == "dot" and (wlen > DOT_MAX_WLEN
-                            or nwin * wlen * wlen > DOT_MAX_MATRIX_ELEMS):
+    if finish == "dot" and (wlen > cap_wlen
+                            or nwin * wlen * wlen > cap_elems):
         raise ValueError(
             f"dot finish materializes a ({nwin}, {wlen}, {wlen}) doubled-"
-            f"window matrix in VMEM; past wlen > DOT_MAX_WLEN={DOT_MAX_WLEN} "
-            f"or nwin*wlen^2 > DOT_MAX_MATRIX_ELEMS={DOT_MAX_MATRIX_ELEMS} "
+            f"window matrix in VMEM; past wlen > dot_max_wlen={cap_wlen} "
+            f"or nwin*wlen^2 > dot_max_matrix_elems={cap_elems} "
             f"use the rfft finish (traj_gather_finish='rfft')")
 
 
-def fused_supported(nwin: int, wlen: int, finish: str) -> bool:
-    """Shape gate used by ``mode="auto"`` resolution in ``ops.xcorr``."""
-    if nwin < 1 or nwin > FUSED_MAX_NWIN:
+def fused_supported(nwin: int, wlen: int, finish: str,
+                    max_nwin: int | None = None,
+                    dot_max_wlen: int | None = None,
+                    dot_max_elems: int | None = None) -> bool:
+    """Shape gate used by ``mode="auto"`` resolution in ``ops.xcorr``.
+    Caps default to the module constants; pass the ``GatherConfig`` fields
+    to honor tuned values."""
+    cap_nwin, cap_wlen, cap_elems = _resolve_caps(max_nwin, dot_max_wlen,
+                                                  dot_max_elems)
+    if nwin < 1 or nwin > cap_nwin:
         return False
-    if finish == "dot" and (wlen > DOT_MAX_WLEN
-                            or nwin * wlen * wlen > DOT_MAX_MATRIX_ELEMS):
+    if finish == "dot" and (wlen > cap_wlen
+                            or nwin * wlen * wlen > cap_elems):
         return False
     return True

@@ -90,9 +90,26 @@ _WIN_BLOCK_DEFAULT = 32
 # reduction kernel's tiles: _PEAK_TILE_P flattened (src x rcv) pair rows by
 # up to _PEAK_TILE_L lag samples (shrunk to fit short records — see
 # _pallas_lag_absmax), 256x512 f32 = 512 KB x2 pipeline buffers at the cap.
+# _PEAK_TILE_L is the DEFAULT of ``RingConfig.lag_tile_max`` (the tuner's
+# sweepable upper bound); the 128 floor below is the hardware lane width
+# and stays a module constant.
 LAGMAX_BLOCK_DEFAULT = 512
 _PEAK_TILE_P = 256
 _PEAK_TILE_L = 512
+
+
+def _bf16_round_complex(wf: jnp.ndarray) -> jnp.ndarray:
+    """Round a complex spectra array's real/imag planes through bfloat16
+    (bf16-valued float32 planes): the input side of the ``"bf16"``
+    precision tier on paths that contract complex operands directly.  On
+    TPU the subsequent DEFAULT-precision contraction runs the MXU's bf16
+    passes; off-TPU the contraction is exact on the bf16-rounded inputs,
+    so the committed error bounds (tests/test_precision.py) measure the
+    same input-rounding semantics everywhere."""
+    wf = jnp.asarray(wf)
+    r = wf.real.astype(jnp.bfloat16).astype(jnp.float32)
+    i = wf.imag.astype(jnp.bfloat16).astype(jnp.float32)
+    return (r + 1j * i).astype(jnp.complex64)
 
 
 def _resolve_win_block(nwin: int, win_block: int | None) -> int:
@@ -142,19 +159,22 @@ def _lag_absmax_kernel(x, out):
     out[:] = jnp.maximum(out[:], m)
 
 
-@partial(jax.jit, static_argnames=("interpret",))
-def _pallas_lag_absmax(lag: jnp.ndarray, interpret: bool = False):
+@partial(jax.jit, static_argnames=("interpret", "lag_tile_max"))
+def _pallas_lag_absmax(lag: jnp.ndarray, interpret: bool = False,
+                       lag_tile_max: int = _PEAK_TILE_L):
     """(npairs, nlag) float32 lag block -> (npairs,) peak |xcorr|, the lag
     axis streamed through the kernel grid with a VMEM-resident accumulator.
     Pads both axes with zeros (safe: |.| >= 0) — the lag axis only to the
     128-lane grain, with the lag tile sized as the largest power-of-two
     multiple of 128 that divides the padded length (capped at
-    ``_PEAK_TILE_L``), so a short ``wlen`` is not inflated to a full 512
-    tile (8x the real bytes at wlen=64)."""
+    ``lag_tile_max``, default ``_PEAK_TILE_L`` = the
+    ``RingConfig.lag_tile_max`` default), so a short ``wlen`` is not
+    inflated to a full 512 tile (8x the real bytes at wlen=64)."""
     npairs, _ = lag.shape
     lp = _pad_to(_pad_to(lag, 0, _PEAK_TILE_P), 1, 128)
+    cap = max(int(lag_tile_max), 128)    # 128 = the lane-width floor
     tile_l = 128
-    while tile_l < _PEAK_TILE_L and lp.shape[1] % (tile_l * 2) == 0:
+    while tile_l < cap and lp.shape[1] % (tile_l * 2) == 0:
         tile_l *= 2
     grid = (lp.shape[0] // _PEAK_TILE_P, lp.shape[1] // tile_l)
     out = pl.pallas_call(
@@ -171,7 +191,8 @@ def _pallas_lag_absmax(lag: jnp.ndarray, interpret: bool = False):
     return jnp.max(out[:npairs], axis=-1)
 
 
-def _fused_peak_finish(cross, wlen: int, rcv_block: int, interpret: bool):
+def _fused_peak_finish(cross, wlen: int, rcv_block: int, interpret: bool,
+                       lag_tile_max: int = _PEAK_TILE_L):
     """(m, nall, nf) cross-spectra -> (m, nall) peak |xcorr| without ever
     materializing the (m, nall, wlen) lag cube: the irfft runs ``rcv_block``
     receiver rows at a time and each slab reduces through the Pallas abs-max
@@ -187,7 +208,9 @@ def _fused_peak_finish(cross, wlen: int, rcv_block: int, interpret: bool):
     if rcv_block >= nall:
         lag = jnp.fft.irfft(cross, n=wlen, axis=-1)
         return _pallas_lag_absmax(lag.reshape(m * nall, wlen),
-                                  interpret=interpret).reshape(m, nall)
+                                  interpret=interpret,
+                                  lag_tile_max=lag_tile_max,
+                                  ).reshape(m, nall)
     pad = (-nall) % rcv_block
     cp = jnp.pad(cross, ((0, 0), (0, pad), (0, 0)))   # receiver rows, not
     n_blocks = cp.shape[1] // rcv_block               # the window axis
@@ -196,7 +219,9 @@ def _fused_peak_finish(cross, wlen: int, rcv_block: int, interpret: bool):
     def one(blk):
         lag = jnp.fft.irfft(blk, n=wlen, axis=-1)     # (m, rcv_block, wlen)
         return _pallas_lag_absmax(lag.reshape(m * rcv_block, wlen),
-                                  interpret=interpret).reshape(m, rcv_block)
+                                  interpret=interpret,
+                                  lag_tile_max=lag_tile_max,
+                                  ).reshape(m, rcv_block)
 
     peaks = lax.map(one, blocks)                      # (n_blocks, m, rcv_block)
     return jnp.moveaxis(peaks, 0, 1).reshape(m, -1)[:, :nall]
@@ -232,8 +257,13 @@ def _spectra_tile_kernel(nwin: int, win_block: int, sr, si, rr, ri, cr, ci):
     acc_r = jnp.zeros(cr.shape, jnp.float32)
     acc_i = jnp.zeros(ci.shape, jnp.float32)
     for wl in range(win_block):
-        a, b = sr[:, wl, :], si[:, wl, :]          # (Ts, fb)
-        c, d = rr[:, wl, :], ri[:, wl, :]          # (Tr, fb)
+        # upcast per-window slices to f32 for the accumulate: a no-op on
+        # the default f32 planes, the f32-accumulation half of the bf16
+        # tier when _planar_padded emitted bfloat16 planes
+        a, b = (sr[:, wl, :].astype(jnp.float32),
+                si[:, wl, :].astype(jnp.float32))  # (Ts, fb)
+        c, d = (rr[:, wl, :].astype(jnp.float32),
+                ri[:, wl, :].astype(jnp.float32))  # (Tr, fb)
         if ragged:
             ok = (w * win_block + wl) < nwin
             a = jnp.where(ok, a, 0.0)
@@ -258,12 +288,20 @@ def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
     return jnp.pad(x, widths)
 
 
-def _planar_padded(wf: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Complex (n, nwin, nf) spectra -> (real, imag) float32 planes padded to
-    the channel/freq tile grid.  The window axis is NEVER padded here — the
-    kernel's ragged-tail mask handles non-divisible window counts."""
-    r = _pad_to(_pad_to(wf.real.astype(jnp.float32), 0, _TILE_CH), 2, _TILE_F)
-    i = _pad_to(_pad_to(wf.imag.astype(jnp.float32), 0, _TILE_CH), 2, _TILE_F)
+def _planar_padded(wf: jnp.ndarray,
+                   precision: str = "f32") -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Complex (n, nwin, nf) spectra -> (real, imag) planes padded to the
+    channel/freq tile grid.  The window axis is NEVER padded here — the
+    kernel's ragged-tail mask handles non-divisible window counts.
+
+    ``precision="bf16"`` emits bfloat16 planes (half the HBM/VMEM footprint
+    of the receiver planes the ring pipeline rotates); the spectra-tile
+    kernel upcasts each window slice to f32 before the accumulate —
+    bf16 inputs, f32 accumulation.  Default emits float32 planes,
+    bit-identical to the pre-tier behavior."""
+    dt = jnp.bfloat16 if precision == "bf16" else jnp.float32
+    r = _pad_to(_pad_to(wf.real.astype(dt), 0, _TILE_CH), 2, _TILE_F)
+    i = _pad_to(_pad_to(wf.imag.astype(dt), 0, _TILE_CH), 2, _TILE_F)
     return r, i
 
 
@@ -301,16 +339,27 @@ def _pallas_cross_spectra(src_r, src_i, all_r, all_i, win_block: int,
     )(src_r, src_i, all_r, all_i)
 
 
-def _einsum_cross_spectra(src_wf, all_wf, win_block: int):
+def _einsum_cross_spectra(src_wf, all_wf, win_block: int,
+                          precision: str = "f32"):
     """Exact-precision fallback with the same streamed window math: full
     win_block slabs accumulate through an unpadded ``fori_loop`` and a
     record-length ragged tail contracts as one static slice — neither
-    operand is copied or padded along the window axis."""
+    operand is copied or padded along the window axis.
+
+    ``precision="bf16"`` rounds both spectra through bfloat16 and drops the
+    contraction to DEFAULT precision (the MXU's bf16 passes on TPU) — the
+    fallback-side twin of the kernel's bf16-planes tier."""
+    if precision == "bf16":
+        src_wf = _bf16_round_complex(src_wf)
+        all_wf = _bf16_round_complex(all_wf)
+        xla_prec = lax.Precision.DEFAULT
+    else:
+        # HIGHEST: TPUs otherwise contract this complex matmul on the MXU in
+        # bfloat16, which visibly degrades the spectra (the Pallas kernel is
+        # exact f32 VPU arithmetic; keep the fallback numerically equivalent)
+        xla_prec = lax.Precision.HIGHEST
     nwin = src_wf.shape[1]
-    # HIGHEST: TPUs otherwise contract this complex matmul on the MXU in
-    # bfloat16, which visibly degrades the spectra (the Pallas kernel is
-    # exact f32 VPU arithmetic; keep the fallback numerically equivalent)
-    ein = partial(jnp.einsum, "swf,rwf->srf", precision=lax.Precision.HIGHEST)
+    ein = partial(jnp.einsum, "swf,rwf->srf", precision=xla_prec)
     if win_block >= nwin:
         return ein(src_wf, jnp.conj(all_wf)) / nwin
     n_full = nwin // win_block
@@ -331,7 +380,8 @@ def _einsum_cross_spectra(src_wf, all_wf, win_block: int):
     return acc / nwin
 
 
-def _make_cross_fn(wf_all, use_pallas: bool, interpret: bool, win_block: int):
+def _make_cross_fn(wf_all, use_pallas: bool, interpret: bool, win_block: int,
+                   precision: str = "f32"):
     """Build ``cross(src_rows) -> (m, nall, nf)`` window-mean cross-spectra
     against the fixed receiver set ``wf_all``.
 
@@ -342,12 +392,13 @@ def _make_cross_fn(wf_all, use_pallas: bool, interpret: bool, win_block: int):
     nall, _, nf = wf_all.shape
     if not use_pallas:
         return lambda src_rows: _einsum_cross_spectra(src_rows, wf_all,
-                                                      win_block)
-    all_r, all_i = _planar_padded(wf_all)
+                                                      win_block,
+                                                      precision=precision)
+    all_r, all_i = _planar_padded(wf_all, precision)
 
     def cross(src_rows):
         m = src_rows.shape[0]
-        src_r, src_i = _planar_padded(src_rows)
+        src_r, src_i = _planar_padded(src_rows, precision)
         cr, ci = _pallas_cross_spectra(src_r, src_i, all_r, all_i,
                                        win_block=win_block,
                                        interpret=interpret)
@@ -364,6 +415,13 @@ def _window_spectra(data: jnp.ndarray, wlen: int,
     offset = int(wlen * (1.0 - overlap_ratio))
     wins = sliding_windows(data, wlen, offset)           # (nch, nwin, wlen)
     return jnp.fft.rfft(wins.astype(jnp.float32), axis=-1)
+
+
+def _check_precision(precision: str) -> str:
+    if precision not in ("f32", "bf16"):
+        raise ValueError(
+            f"precision must be 'f32' or 'bf16', got {precision!r}")
+    return precision
 
 
 def _decide_pallas(nch: int, use_pallas: bool | None) -> bool:
@@ -388,7 +446,8 @@ def xcorr_all_pairs(data: jnp.ndarray, wlen: int, overlap_ratio: float = 0.5,
                     lag_keep: int | None = None, src_chunk: int = 128,
                     use_pallas: bool | None = None,
                     interpret: bool = False,
-                    win_block: int | None = None) -> jnp.ndarray:
+                    win_block: int | None = None,
+                    precision: str = "f32") -> jnp.ndarray:
     """All-pairs lag-domain xcorr, zero lag centered — the (nch, nch, ...)
     generalization of ``xcorr_vshot_batch`` (parity-tested against it in
     tests/test_pallas_xcorr.py).
@@ -406,7 +465,8 @@ def xcorr_all_pairs(data: jnp.ndarray, wlen: int, overlap_ratio: float = 0.5,
     wf = _window_spectra(data, wlen, overlap_ratio)
     use_p = _decide_pallas(wf.shape[0], use_pallas)
     wb = _resolve_win_block(wf.shape[1], win_block)
-    cross = _make_cross_fn(wf, use_p, interpret, wb)
+    cross = _make_cross_fn(wf, use_p, interpret, wb,
+                           precision=_check_precision(precision))
     mid = wlen // 2
     sl = slice(0, wlen) if lag_keep is None else slice(mid - lag_keep,
                                                        mid + lag_keep + 1)
@@ -423,7 +483,9 @@ def xcorr_all_pairs_peak(data: jnp.ndarray, wlen: int,
                          use_pallas: bool | None = None,
                          interpret: bool = False,
                          win_block: int | None = None,
-                         lagmax_block: int | None = None) -> jnp.ndarray:
+                         lagmax_block: int | None = None,
+                         lag_tile_max: int = _PEAK_TILE_L,
+                         precision: str = "f32") -> jnp.ndarray:
     """Per-pair peak |xcorr| over all lags: (nch, nch) float32.
 
     The fully streamed form for channel counts where even a trimmed lag
@@ -445,13 +507,16 @@ def xcorr_all_pairs_peak(data: jnp.ndarray, wlen: int,
     wf = _window_spectra(data, wlen, overlap_ratio)
     use_p = _decide_pallas(wf.shape[0], use_pallas)
     return peak_from_spectra(wf, wf, wlen, src_chunk, use_p, interpret,
-                             win_block=win_block, lagmax_block=lagmax_block)
+                             win_block=win_block, lagmax_block=lagmax_block,
+                             lag_tile_max=lag_tile_max, precision=precision)
 
 
 def peak_from_spectra(wf_src, wf_all, wlen: int, src_chunk: int,
                       use_pallas: bool, interpret: bool = False,
                       win_block: int | None = None,
-                      lagmax_block: int | None = None):
+                      lagmax_block: int | None = None,
+                      lag_tile_max: int = _PEAK_TILE_L,
+                      precision: str = "f32"):
     """Peak |xcorr| of every ``wf_src`` row against every ``wf_all`` row:
     (nsrc, nall) float32.  Split out so a sharded caller
     (``parallel.allpairs``) can hand each device its own source-row block
@@ -470,15 +535,22 @@ def peak_from_spectra(wf_src, wf_all, wlen: int, src_chunk: int,
     lag-streaming max whose accumulator stays VMEM-resident, so the
     (src_chunk, nall, wlen) lag cube of the unfused finish never exists in
     HBM.  The einsum fallback keeps the unfused finish by default (exact
-    parity reference).  Negative values raise ``ValueError``."""
+    parity reference).  Negative values raise ``ValueError``.
+
+    ``lag_tile_max`` caps the lag-axis tile auto-sizing of the fused
+    finish (``RingConfig.lag_tile_max``); ``precision`` selects the
+    f32/bf16 tier of the cross-spectra stage (``RingConfig.precision``,
+    see ``_planar_padded`` / ``_einsum_cross_spectra``)."""
     wb = _resolve_win_block(wf_src.shape[1], win_block)
     lb = _resolve_lagmax_block(wf_all.shape[0], use_pallas, lagmax_block)
-    cross = _make_cross_fn(wf_all, use_pallas, interpret, wb)
+    cross = _make_cross_fn(wf_all, use_pallas, interpret, wb,
+                           precision=_check_precision(precision))
 
     def finish(src_rows):
         c = cross(src_rows)
         if lb:
-            return _fused_peak_finish(c, wlen, lb, interpret)
+            return _fused_peak_finish(c, wlen, lb, interpret,
+                                      lag_tile_max=lag_tile_max)
         lag = jnp.fft.irfft(c, n=wlen, axis=-1)
         return jnp.max(jnp.abs(lag), axis=-1)
 

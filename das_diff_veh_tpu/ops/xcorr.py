@@ -233,7 +233,9 @@ def xcorr_vshot_at(data: jnp.ndarray, ivs, start, nsamp: int, wlen: int,
 
 
 def _decide_traj_gather(mode: str | None, nwin: int, wlen: int,
-                        finish: str) -> bool:
+                        finish: str, *, max_nwin: int | None = None,
+                        dot_max_wlen: int | None = None,
+                        dot_max_elems: int | None = None) -> bool:
     """Resolve the gather-path knob to fused (True) / serialized (False).
 
     ``"auto"`` (the :class:`~das_diff_veh_tpu.config.GatherConfig` default)
@@ -255,7 +257,9 @@ def _decide_traj_gather(mode: str | None, nwin: int, wlen: int,
         if degrade.demoted(degrade.GATHER_FUSED):
             return False
         return (jax.default_backend() in ("tpu", "axon")
-                and fused_supported(nwin, wlen, finish))
+                and fused_supported(nwin, wlen, finish, max_nwin=max_nwin,
+                                    dot_max_wlen=dot_max_wlen,
+                                    dot_max_elems=dot_max_elems))
     if mode == "serialized":
         return False
     if mode == "fused":
@@ -269,7 +273,11 @@ def xcorr_traj_follow(data: jnp.ndarray, t_axis: jnp.ndarray, pivot_idx: int,
                       nsamp: int, wlen: int, overlap_ratio: float = 0.5,
                       reverse: bool = False, *, mode: str | None = "auto",
                       finish: str = "rfft",
-                      interpret: bool | None = None) -> jnp.ndarray:
+                      interpret: bool | None = None,
+                      max_nwin: int | None = None,
+                      dot_max_wlen: int | None = None,
+                      dot_max_elems: int | None = None,
+                      precision: str = "f32") -> jnp.ndarray:
     """Trajectory-following pair correlations (reference
     apis/virtual_shot_gather.py:14-43 xcorr_two_traces_based_on_traj).
 
@@ -290,14 +298,26 @@ def xcorr_traj_follow(data: jnp.ndarray, t_axis: jnp.ndarray, pivot_idx: int,
     serialized path); ``"dot"`` finishes the correlation in-kernel as an
     MXU dot (small ``wlen`` only).  ``interpret`` follows
     ``ops.pallas_xcorr`` convention (None = interpret off-TPU).
+
+    ``max_nwin`` / ``dot_max_wlen`` / ``dot_max_elems`` override the fused
+    kernel's support caps (``GatherConfig.fused_max_nwin`` /
+    ``dot_max_wlen`` / ``dot_max_matrix_elems``; None = the module
+    defaults).  ``precision`` selects the "dot" finish's MXU tier
+    (``"bf16"`` = bf16 operands, f32 accumulation); the rfft and
+    serialized paths ignore it — they never touch the MXU.
     """
     dt_idx = jnp.argmax(t_axis[None, :] >= t_at_ch[:, None], axis=-1)
     offset = int(wlen * (1.0 - overlap_ratio))
     nwin = (nsamp - wlen) // offset + 1
-    if _decide_traj_gather(mode, nwin, wlen, finish):
+    if _decide_traj_gather(mode, nwin, wlen, finish, max_nwin=max_nwin,
+                           dot_max_wlen=dot_max_wlen,
+                           dot_max_elems=dot_max_elems):
         return _traj_follow_fused(data, pivot_idx, ch_indices, dt_idx,
                                   nsamp, wlen, offset, reverse, finish,
-                                  interpret)
+                                  interpret, max_nwin=max_nwin,
+                                  dot_max_wlen=dot_max_wlen,
+                                  dot_max_elems=dot_max_elems,
+                                  precision=precision)
 
     def one(ch, ti):
         tr_ch = data[ch]
@@ -315,7 +335,11 @@ def xcorr_traj_follow(data: jnp.ndarray, t_axis: jnp.ndarray, pivot_idx: int,
 
 def _traj_follow_fused(data, pivot_idx, ch_indices, dt_idx, nsamp: int,
                        wlen: int, offset: int, reverse: bool, finish: str,
-                       interpret: bool | None) -> jnp.ndarray:
+                       interpret: bool | None, *,
+                       max_nwin: int | None = None,
+                       dot_max_wlen: int | None = None,
+                       dot_max_elems: int | None = None,
+                       precision: str = "f32") -> jnp.ndarray:
     """Fused gather path: one Pallas scalar-prefetch sweep cuts every
     channel's (and the pivot's) windows at that channel's data-dependent
     start; the circular correlate runs on the packed windows (``"rfft"``)
@@ -326,10 +350,12 @@ def _traj_follow_fused(data, pivot_idx, ch_indices, dt_idx, nsamp: int,
     if finish == "dot":
         return pg.traj_follow_correlate_dot(
             data, pivot_idx, ch_indices, dt_idx, nsamp, wlen, offset,
-            backward=reverse, swap=reverse, interpret=interpret)
+            backward=reverse, swap=reverse, interpret=interpret,
+            max_nwin=max_nwin, dot_max_wlen=dot_max_wlen,
+            dot_max_elems=dot_max_elems, precision=precision)
     wins_ch, wins_pv, n_eff = pg.traj_follow_windows(
         data, pivot_idx, ch_indices, dt_idx, nsamp, wlen, offset,
-        backward=reverse, interpret=interpret)
+        backward=reverse, interpret=interpret, max_nwin=max_nwin)
     cf = jnp.fft.rfft(wins_ch, axis=-1)                 # (nk, nwin, nf)
     pf = jnp.fft.rfft(wins_pv, axis=-1)
     src_f, rcv_f = (pf, cf) if reverse else (cf, pf)

@@ -132,7 +132,9 @@ def sharded_all_pairs_peak(data: jnp.ndarray, wlen: int, mesh: Mesh, *,
     if ring.mode == "ring":
         faults.fire("parallel.ring")
     _observe_ring_build(mesh, ring, registry)
-    _resolve_win_block(1, win_block)        # validate before any device work
+    # validate before any device work (per-call override or the config knob)
+    _resolve_win_block(1, win_block if win_block is not None
+                       else ring.win_block)
     _resolve_lagmax_block(1, False, ring.lagmax_block)
     nch = data.shape[0]
     n_dev = mesh.shape[axis]
@@ -149,7 +151,13 @@ def sharded_all_pairs_peak(data: jnp.ndarray, wlen: int, mesh: Mesh, *,
     wf = _sharded_window_spectra(dpad, wlen, overlap_ratio,
                                  NamedSharding(mesh, P(axis, None, None)))
 
-    kernel_kw = dict(win_block=win_block, lagmax_block=ring.lagmax_block)
+    # per-call win_block wins over the RingConfig knob (the tuner writes the
+    # config field; explicit callers keep their override)
+    kernel_kw = dict(win_block=win_block if win_block is not None
+                     else ring.win_block,
+                     lagmax_block=ring.lagmax_block,
+                     lag_tile_max=ring.lag_tile_max,
+                     precision=ring.precision)
 
     if ring.mode == "replicated":
         # pre-ring layout: full receiver set broadcast to every device, no

@@ -38,7 +38,8 @@ from das_diff_veh_tpu.obs import (FlightRecorder, HBMSampler, MetricsSink,
                                   register_memory_gauges, xla_events)
 from das_diff_veh_tpu.pipeline.timelapse import process_chunk
 from das_diff_veh_tpu.runtime import (ChunkTask, RunManifest, RuntimeConfig,
-                                      config_hash, make_tracer, run_pipelined)
+                                      config_hash, consult_tuner, make_tracer,
+                                      run_pipelined)
 
 log = logging.getLogger("das_diff_veh_tpu.workflow")
 
@@ -210,6 +211,10 @@ def run_directory(dataset: DirectoryDataset, cfg: Optional[PipelineConfig] = Non
                                  interval_s=obs_cfg.hbm_sample_interval_s)
             if obs_cfg.flight_dir is not None:
                 signals_installed = flight.install_signal_handlers()
+        # --- tuner: apply persisted knob winners BEFORE hashing -----------------
+        # (the manifest hash must fingerprint the config that actually runs,
+        # so a tuned resume never absorbs default-knob chunks or vice versa)
+        cfg, _tuned = consult_tuner(cfg, runtime, registry=registry)
         # --- manifest: load-or-invalidate, restore partial state ----------------
         chash = _run_config_hash(cfg, method, x_is_channels, dataset)
         if flight is not None:

@@ -18,7 +18,8 @@ computes, accumulates".
 
 from das_diff_veh_tpu.runtime.config import RuntimeConfig
 from das_diff_veh_tpu.runtime.executor import (ChunkTask, ExecStats,
-                                               QuarantineRecord, run_pipelined)
+                                               QuarantineRecord,
+                                               consult_tuner, run_pipelined)
 from das_diff_veh_tpu.runtime.manifest import RunManifest, config_hash
 from das_diff_veh_tpu.runtime.prefetch import PrefetchLoader
 from das_diff_veh_tpu.runtime.tracing import (NullTracer, TraceWriter,
@@ -26,6 +27,7 @@ from das_diff_veh_tpu.runtime.tracing import (NullTracer, TraceWriter,
 
 __all__ = [
     "RuntimeConfig", "ChunkTask", "ExecStats", "QuarantineRecord",
-    "run_pipelined", "RunManifest", "config_hash", "PrefetchLoader",
-    "NullTracer", "TraceWriter", "load_trace", "make_tracer",
+    "consult_tuner", "run_pipelined", "RunManifest", "config_hash",
+    "PrefetchLoader", "NullTracer", "TraceWriter", "load_trace",
+    "make_tracer",
 ]

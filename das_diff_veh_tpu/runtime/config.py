@@ -58,3 +58,20 @@ class RuntimeConfig:
     flight-recorder dumps on quarantine/SIGTERM, the steady-state profiler
     window, trace flush batching (see :class:`~das_diff_veh_tpu.config.ObsConfig`
     and docs/OBSERVABILITY.md)."""
+
+    tuner_store: Optional[str] = None
+    """Path to a tuner-store JSON (``das_diff_veh_tpu.tune``).  When set,
+    the batch workflow consults it at start-of-run
+    (:func:`~das_diff_veh_tpu.runtime.executor.consult_tuner`) and applies
+    any persisted knob winners for this backend/geometry/config before
+    compiling.  None (default): defaults run untouched.  Living here is
+    consistent with the PipelineConfig/RuntimeConfig split: which *store*
+    to read is execution policy, while the applied knobs land in
+    PipelineConfig and therefore in the manifest hash (a tuned run and a
+    default run never share resume state)."""
+
+    tuner_geometry: str = "default"
+    """Deployment-geometry label the tuner keys winners under (channel
+    count / spacing / record length change the optimum, and none of them
+    are visible in PipelineConfig).  Operators name their fiber sections;
+    the default label is for single-deployment installs."""

@@ -62,6 +62,34 @@ class ChunkTask:
     load: Callable[[], Any]
 
 
+def consult_tuner(cfg, runtime_cfg: RuntimeConfig,
+                  registry: Optional[MetricsRegistry] = None):
+    """Apply persisted tuner winners to ``cfg`` per the runtime's policy.
+
+    Returns ``(cfg, entry)``: the (possibly) tuned PipelineConfig plus the
+    store entry that was applied, or ``(cfg, None)`` untouched when
+    ``RuntimeConfig.tuner_store`` is unset or the store has nothing for
+    this (backend, geometry, config).  Soft by contract — a corrupt store,
+    a hash mismatch, any failure at all resolves to default knobs
+    (``das_tuner_consults_total{status=...}`` counts hit/miss/disabled for
+    the obs stack), so batch start can never crash on tuning state.
+    """
+    if runtime_cfg.tuner_store is None:
+        return cfg, None
+    from das_diff_veh_tpu.tune import load_tuned
+    cfg, _, entry = load_tuned(cfg, runtime_cfg.tuner_store,
+                               runtime_cfg.tuner_geometry)
+    if registry is not None:
+        registry.counter(
+            "das_tuner_consults_total",
+            "tuner-store consultations by outcome", labels=("status",),
+        ).labels(status="hit" if entry is not None else "miss").inc()
+    if entry is not None:
+        log.info("tuner store %s: applied winners %s",
+                 runtime_cfg.tuner_store, entry.winners)
+    return cfg, entry
+
+
 @dataclass
 class QuarantineRecord:
     key: str

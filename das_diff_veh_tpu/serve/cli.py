@@ -55,7 +55,15 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch_window_ms", type=float, default=2.0,
                    help="DEPRECATED, ignored: batching is continuous "
                         "(iteration-level); kept so existing invocations "
-                        "keep parsing")
+                        "keep parsing (a non-default value raises "
+                        "DeprecationWarning at config construction)")
+    p.add_argument("--tuner_store", default=None, metavar="PATH",
+                   help="tuner-store JSON (das_diff_veh_tpu.tune): apply "
+                        "persisted knob winners for this backend/geometry "
+                        "before warmup (docs/TUNING.md)")
+    p.add_argument("--tuner_geometry", default="default", metavar="LABEL",
+                   help="deployment-geometry label the tuner store is keyed "
+                        "under")
     mesh = p.add_argument_group(
         "mesh serving",
         "multi-tenant engine across the device mesh (docs/SERVING.md)")
@@ -114,7 +122,9 @@ def serve_main(argv=None) -> int:
                          flush_interval_s=args.trace_flush_interval)
     factory = ImagingComputeFactory(cfg, method=args.method,
                                     x_is_channels=args.x_is_channels,
-                                    fs=args.fs)
+                                    fs=args.fs,
+                                    tuner_store=args.tuner_store,
+                                    tuner_geometry=args.tuner_geometry)
     # the process-default registry: ring/runtime metrics registered anywhere
     # in this process land in the same GET /metrics scrape as das_serve_*
     if args.mesh:

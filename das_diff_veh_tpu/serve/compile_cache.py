@@ -42,6 +42,13 @@ class ComputeFactory:
 
     config_key: str = ""
 
+    tuner_entry = None
+    """Tuner-store entry applied to this factory's config at construction
+    (``das_diff_veh_tpu.tune``), or None when running default knobs.
+    Factories that consult the store (serve/imaging.py) set it *before*
+    computing ``config_key``, so tuned and default deployments never share
+    cache entries; ``warmup`` logs it as build provenance."""
+
     def build(self, bucket: Bucket) -> ComputeFn:
         raise NotImplementedError
 
@@ -131,6 +138,13 @@ class CompiledFunctionCache:
             program = self._build(bucket, placement)
             self._programs[key] = program
         self._metrics.inc("warmup_builds")
+        tuned = getattr(self._factory, "tuner_entry", None)
+        if tuned is not None:
+            # tuned-knob provenance: this warmed program IS the tuned one
+            # (the factory applied winners before computing config_key)
+            self._metrics.inc("tuned_warmups")
+            log.info("bucket %s warms with tuned knobs %s", bucket,
+                     tuned.winners)
         section = self._factory.warmup_section(bucket)
         if device is not None:
             import jax

@@ -60,7 +60,8 @@ class ServeMetrics:
                "shed_invalid", "shed_poison",
                "shed_quota", "shed_quarantined", "shed_draining",
                "continuous_admitted",
-               "cache_hits", "cache_misses", "warmup_builds")
+               "cache_hits", "cache_misses", "warmup_builds",
+               "tuned_warmups")
 
     def __init__(self, latency_window: int = 1024,
                  registry: Optional[MetricsRegistry] = None):

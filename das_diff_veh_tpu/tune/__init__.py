@@ -1,0 +1,21 @@
+"""Obs-driven kernel/serve knob autotuner (docs/TUNING.md).
+
+Sweeps the whitelisted execution knobs per ``(backend, geometry)`` with the
+bench timing harness as the measurement source, persists winners in a
+config-hash-keyed JSON store (``tune.store``), and applies them at batch
+start (``runtime.executor.consult_tuner``) and serve warmup
+(``serve.imaging.ImagingComputeFactory``).  Defaults always remain a safe
+answer: every store failure mode degrades to "no tuned values".
+"""
+
+from das_diff_veh_tpu.tune.store import (STORE_VERSION, TunedEntry,
+                                         TunerStore, store_key)
+from das_diff_veh_tpu.tune.tuner import (TUNABLE_KNOBS, KnobSpec,
+                                         apply_winners, base_hash,
+                                         load_tuned, sweep_knobs, tune)
+
+__all__ = [
+    "STORE_VERSION", "TunedEntry", "TunerStore", "store_key",
+    "TUNABLE_KNOBS", "KnobSpec", "apply_winners", "base_hash",
+    "load_tuned", "sweep_knobs", "tune",
+]

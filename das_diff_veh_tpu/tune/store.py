@@ -1,0 +1,117 @@
+"""Config-hash-keyed persistence for tuned kernel/serve knobs.
+
+Same durability pattern as the resume manifest (``runtime/manifest.py``):
+one JSON file, atomically replaced on every write, versioned, and *soft* on
+every failure mode — a corrupt, truncated, or foreign-version store file
+means "no tuned values", never a crashed warmup.  Entries are keyed
+``"<backend>|<geometry>|<config_hash>"``:
+
+- ``backend``: ``jax.default_backend()`` at sweep time — a winner measured
+  on a v5e says nothing about CPU block sizes;
+- ``geometry``: an operator-chosen fiber/deployment label (channel count,
+  spacing and record length all change the optimum);
+- ``config_hash``: ``runtime.manifest.config_hash`` of the PipelineConfig
+  the sweep timed, with the swept knobs themselves *reset to defaults*
+  before hashing (``base_hash`` below) — otherwise applying the winners
+  would change the hash and every lookup after the first would miss.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from das_diff_veh_tpu.runtime.manifest import _atomic_write_json
+
+log = logging.getLogger("das_diff_veh_tpu.tune")
+
+STORE_VERSION = 1
+
+
+def store_key(backend: str, geometry: str, chash: str) -> str:
+    return f"{backend}|{geometry}|{chash}"
+
+
+@dataclass
+class TunedEntry:
+    """One sweep's outcome: the winning knob values plus provenance."""
+
+    winners: Dict[str, Any]
+    """Dotted knob path -> winning value (see ``tune.tuner.TUNABLE_KNOBS``)."""
+
+    meta: Dict[str, Any] = field(default_factory=dict)
+    """Sweep provenance: baseline/tuned seconds, reps, sweep order — kept
+    for docs/bench reporting, never consulted at load time."""
+
+    def to_json(self) -> dict:
+        return {"winners": self.winners, "meta": self.meta}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "TunedEntry":
+        return cls(winners=dict(d.get("winners", {})),
+                   meta=dict(d.get("meta", {})))
+
+
+class TunerStore:
+    """Load/lookup/record tuned winners in one JSON file.
+
+    ``load`` (implicit on first access) never raises for a bad file: a
+    missing path is an empty store, and an unreadable/corrupt/foreign-
+    version file is *warned about* and treated as empty — the contract
+    warmup depends on (tests/test_tune.py pins every failure mode).
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._entries: Optional[Dict[str, TunedEntry]] = None
+
+    # -- persistence ---------------------------------------------------------
+    def load(self) -> Dict[str, TunedEntry]:
+        if self._entries is not None:
+            return self._entries
+        self._entries = {}
+        if not os.path.exists(self.path):
+            return self._entries
+        try:
+            with open(self.path) as f:
+                d = json.load(f)
+        except (json.JSONDecodeError, OSError) as e:
+            log.warning("tuner store %s unreadable (%s: %s); falling back "
+                        "to default knobs", self.path, type(e).__name__, e)
+            return self._entries
+        if not isinstance(d, dict) or d.get("version") != STORE_VERSION:
+            log.warning("tuner store %s has version %r (want %d); falling "
+                        "back to default knobs", self.path,
+                        d.get("version") if isinstance(d, dict) else None,
+                        STORE_VERSION)
+            return self._entries
+        try:
+            for k, v in d.get("entries", {}).items():
+                self._entries[k] = TunedEntry.from_json(v)
+        except (AttributeError, TypeError) as e:
+            log.warning("tuner store %s malformed (%s: %s); falling back "
+                        "to default knobs", self.path, type(e).__name__, e)
+            self._entries = {}
+        return self._entries
+
+    def save(self) -> None:
+        entries = self.load()
+        _atomic_write_json(self.path, {
+            "version": STORE_VERSION,
+            "entries": {k: e.to_json() for k, e in sorted(entries.items())}})
+
+    # -- access --------------------------------------------------------------
+    def lookup(self, backend: str, geometry: str,
+               chash: str) -> Optional[TunedEntry]:
+        """The tuned entry for this exact (backend, geometry, config), or
+        None — a config-hash mismatch is just a miss (the caller re-tunes
+        or runs defaults; stale winners are never applied)."""
+        return self.load().get(store_key(backend, geometry, chash))
+
+    def record(self, backend: str, geometry: str, chash: str,
+               entry: TunedEntry) -> None:
+        self.load()[store_key(backend, geometry, chash)] = entry
+        self.save()

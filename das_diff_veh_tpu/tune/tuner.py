@@ -1,0 +1,224 @@
+"""Knob sweep (AutoTVM/Ansor-style, degenerate search space) + application.
+
+The tuner treats a small whitelist of *execution* knobs — Pallas block
+sizes, fused-kernel support caps, the chunk-pipeline dispatch mode — as a
+search space and greedily coordinate-descends it: knobs are swept in order,
+each candidate timed with the caller's ``time_fn`` (typically a thin
+wrapper over the bench harness's best-of-reps pattern), and a candidate
+only displaces the incumbent when it is measurably faster.  The search is
+deliberately primitive next to Ansor's learned cost model: the space here
+is tens of points, not billions, so exhaustive-per-knob timing IS the
+cheap, robust answer.
+
+Two invariants the whitelist enforces:
+
+- **physics never tunes**: every path in ``TUNABLE_KNOBS`` is an execution
+  knob whose value cannot change an output bit on the kernel path (block
+  sizes, caps, dispatch mode).  Physics knobs are not sweepable and an
+  attempt to apply one is warn-and-skipped, never obeyed.
+- **precision never tunes**: the bf16 tier trades accuracy for throughput
+  under a committed error budget — an *operator* decision, not a timing
+  winner.  ``*.precision`` is excluded on purpose.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+from das_diff_veh_tpu.config import PipelineConfig, RingConfig
+from das_diff_veh_tpu.runtime.manifest import config_hash
+from das_diff_veh_tpu.tune.store import TunedEntry, TunerStore
+
+log = logging.getLogger("das_diff_veh_tpu.tune")
+
+TUNABLE_KNOBS = frozenset({
+    # gather: fused-kernel support caps + dispatch knobs
+    "gather.traj_gather",
+    "gather.traj_gather_finish",
+    "gather.fused_max_nwin",
+    "gather.dot_max_wlen",
+    "gather.dot_max_matrix_elems",
+    # ring all-pairs: block sizes / tile bounds (RingConfig root)
+    "ring.win_block",
+    "ring.lagmax_block",
+    "ring.lag_tile_max",
+    # per-chunk pipeline dispatch mode
+    "chunk_pipeline",
+})
+"""Dotted knob paths the tuner may sweep/apply.  ``ring.*`` roots at a
+:class:`~das_diff_veh_tpu.config.RingConfig` (not part of PipelineConfig);
+everything else roots at :class:`~das_diff_veh_tpu.config.PipelineConfig`.
+``*.precision`` and all physics knobs are excluded by construction."""
+
+
+@dataclass(frozen=True)
+class KnobSpec:
+    """One swept knob: a whitelisted dotted path + candidate values.
+
+    The *current* config value is always implicitly a candidate (the
+    incumbent a challenger must beat), so ``candidates`` need only list the
+    alternatives."""
+
+    path: str
+    candidates: Tuple[Any, ...]
+
+    def __post_init__(self):
+        if self.path not in TUNABLE_KNOBS:
+            raise ValueError(
+                f"{self.path!r} is not a tunable knob; sweepable paths: "
+                f"{sorted(TUNABLE_KNOBS)}")
+
+
+def _get_path(root, path: str):
+    for part in path.split("."):
+        root = getattr(root, part)
+    return root
+
+
+def _replace_path(root, path: str, value):
+    head, _, rest = path.partition(".")
+    if not rest:
+        return dataclasses.replace(root, **{head: value})
+    return dataclasses.replace(
+        root, **{head: _replace_path(getattr(root, head), rest, value)})
+
+
+def apply_winners(cfg: PipelineConfig, winners: Dict[str, Any],
+                  ring: Optional[RingConfig] = None,
+                  ) -> Tuple[PipelineConfig, Optional[RingConfig]]:
+    """Apply a winners dict onto the config tree; returns (cfg, ring).
+
+    Only whitelisted paths are obeyed; anything else — a physics knob, a
+    precision field, a path from a future version's store — is warned
+    about and skipped, so a hand-edited or forward-versioned store can
+    degrade a run's speed but never its correctness or its ability to
+    start.  ``ring.*`` entries are skipped (with a warning) when no
+    ``ring`` is passed: the caller has no ring engine to apply them to.
+    """
+    for path, value in winners.items():
+        if path not in TUNABLE_KNOBS:
+            log.warning("tuned knob %r is not in the tunable whitelist; "
+                        "skipping", path)
+            continue
+        if path.startswith("ring."):
+            if ring is None:
+                log.warning("tuned knob %r needs a RingConfig; skipping",
+                            path)
+                continue
+            ring = _replace_path(ring, path[len("ring."):], value)
+        else:
+            cfg = _replace_path(cfg, path, value)
+    return cfg, ring
+
+
+def base_hash(cfg: PipelineConfig) -> str:
+    """Store key hash: the config with every sweepable PipelineConfig knob
+    reset to its default.  Hashing the *base* (not the tuned) config keeps
+    the key stable across apply→lookup cycles: applying winners would
+    otherwise change the hash and every lookup after the first would
+    miss its own entry."""
+    ref = PipelineConfig()
+    for path in sorted(TUNABLE_KNOBS):
+        if not path.startswith("ring."):
+            cfg = _replace_path(cfg, path, _get_path(ref, path))
+    return config_hash(cfg)
+
+
+def _best_time(time_fn, cfg, ring, reps: int) -> float:
+    return min(time_fn(cfg, ring) for _ in range(max(1, int(reps))))
+
+
+def sweep_knobs(base_cfg: PipelineConfig, knobs: Sequence[KnobSpec],
+                time_fn: Callable[[PipelineConfig, Optional[RingConfig]], float],
+                reps: int = 2,
+                ring: Optional[RingConfig] = None) -> TunedEntry:
+    """Greedy coordinate descent over ``knobs``; returns the winners.
+
+    ``time_fn(cfg, ring) -> seconds`` is the measurement source — the
+    caller owns warmup/dispatch semantics (the bench harness's
+    K-in-dispatch amortized timing is the intended implementation; tests
+    use a stub).  Each knob is swept holding earlier winners fixed; a
+    candidate must beat the incumbent's best-of-``reps`` time to win, so
+    the returned winners never include a knob whose default already won.
+    """
+    cur_cfg, cur_ring = base_cfg, ring
+    t_base = _best_time(time_fn, cur_cfg, cur_ring, reps)
+    t_cur = t_base
+    winners: Dict[str, Any] = {}
+    trace = []
+    for spec in knobs:
+        best_val, best_t = None, t_cur
+        for cand in spec.candidates:
+            cfg_c, ring_c = apply_winners(cur_cfg, {spec.path: cand},
+                                          cur_ring)
+            t = _best_time(time_fn, cfg_c, ring_c, reps)
+            trace.append({"path": spec.path, "value": repr(cand),
+                          "best_s": t})
+            if t < best_t:
+                best_val, best_t = cand, t
+        if best_val is not None:
+            winners[spec.path] = best_val
+            cur_cfg, cur_ring = apply_winners(cur_cfg,
+                                              {spec.path: best_val},
+                                              cur_ring)
+            t_cur = best_t
+    return TunedEntry(winners=winners,
+                      meta={"baseline_s": t_base, "tuned_s": t_cur,
+                            "speedup": (t_base / t_cur) if t_cur > 0 else 1.0,
+                            "reps": int(reps), "trace": trace})
+
+
+def tune(store: TunerStore, backend: str, geometry: str,
+         cfg: PipelineConfig, knobs: Sequence[KnobSpec],
+         time_fn, reps: int = 2, ring: Optional[RingConfig] = None,
+         force: bool = False,
+         ) -> Tuple[PipelineConfig, Optional[RingConfig], TunedEntry]:
+    """Lookup-or-sweep: the tuned config for this (backend, geometry, cfg).
+
+    A store hit (same backend, geometry, and base config hash) applies the
+    persisted winners without re-measuring; a miss — including a config-
+    hash mismatch from any upstream config change — runs the sweep and
+    records the outcome.  ``force=True`` re-sweeps unconditionally
+    (refreshing a stale winner after a software update)."""
+    chash = base_hash(cfg)
+    entry = None if force else store.lookup(backend, geometry, chash)
+    if entry is None:
+        entry = sweep_knobs(cfg, knobs, time_fn, reps=reps, ring=ring)
+        store.record(backend, geometry, chash, entry)
+        log.info("tuner swept %s|%s|%s: winners=%s speedup=%.2fx",
+                 backend, geometry, chash, entry.winners,
+                 entry.meta.get("speedup", 1.0))
+    else:
+        log.info("tuner store hit %s|%s|%s: winners=%s", backend, geometry,
+                 chash, entry.winners)
+    tuned_cfg, tuned_ring = apply_winners(cfg, entry.winners, ring)
+    return tuned_cfg, tuned_ring, entry
+
+
+def load_tuned(cfg: PipelineConfig, store_path: str, geometry: str,
+               backend: Optional[str] = None,
+               ring: Optional[RingConfig] = None,
+               ) -> Tuple[PipelineConfig, Optional[RingConfig],
+                          Optional[TunedEntry]]:
+    """Lookup-only store consultation (the warmup/executor entry point).
+
+    Never sweeps, never raises: any store problem or a plain miss returns
+    the config unchanged with ``entry=None`` — defaults are always a safe
+    answer at warmup time."""
+    try:
+        if backend is None:
+            import jax
+            backend = jax.default_backend()
+        entry = TunerStore(store_path).lookup(backend, geometry,
+                                              base_hash(cfg))
+    except Exception as e:       # never let tuning break a warmup
+        log.warning("tuner store consultation failed (%s: %s); running "
+                    "default knobs", type(e).__name__, e)
+        return cfg, ring, None
+    if entry is None:
+        return cfg, ring, None
+    cfg, ring = apply_winners(cfg, entry.winners, ring)
+    return cfg, ring, entry

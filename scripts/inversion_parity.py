@@ -51,8 +51,8 @@ from das_diff_veh_tpu.cache import enable_compilation_cache  # noqa: E402
 enable_compilation_cache(_REPO)
 
 from das_diff_veh_tpu.inversion import (curves_from_ridges,  # noqa: E402
-                                        load_reference_ridge_npz,
                                         invert, invert_multirun,
+                                        load_reference_ridge_npz,
                                         make_misfit_fn,
                                         phase_velocity,
                                         scan_mode_diagnostics,

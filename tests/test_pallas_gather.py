@@ -200,8 +200,9 @@ def test_invalid_knobs_rejected():
         jx.xcorr_traj_follow(data, t_axis, PIVOT, ch, t_at_ch, NSAMP, WLEN,
                              mode="fused", finish="fft2")
     # dot finish past the VMEM cap: explicit request raises with guidance
+    # (error names the GatherConfig knob the cap now lives on)
     big_wlen = DOT_MAX_WLEN + 2
-    with pytest.raises(ValueError, match="DOT_MAX_WLEN"):
+    with pytest.raises(ValueError, match="dot_max_wlen"):
         jx.xcorr_traj_follow(data, t_axis, PIVOT, ch, t_at_ch,
                              4 * big_wlen, big_wlen, mode="fused",
                              finish="dot")
@@ -212,7 +213,7 @@ def test_invalid_knobs_rejected():
     nwin_many = 17                                      # 17*256^2 > 2^20
     nsamp_many = (nwin_many - 1) * (DOT_MAX_WLEN // 2) + DOT_MAX_WLEN
     assert not fused_supported(nwin_many, DOT_MAX_WLEN, "dot")
-    with pytest.raises(ValueError, match="DOT_MAX_MATRIX_ELEMS"):
+    with pytest.raises(ValueError, match="dot_max_matrix_elems"):
         jx.xcorr_traj_follow(data, t_axis, PIVOT, ch, t_at_ch,
                              nsamp_many, DOT_MAX_WLEN, mode="fused",
                              finish="dot")
@@ -221,7 +222,7 @@ def test_invalid_knobs_rejected():
     small_wlen = 16
     nsamp_big = (FUSED_MAX_NWIN + 2) * (small_wlen // 2) + small_wlen
     assert not fused_supported(FUSED_MAX_NWIN + 2, small_wlen, "rfft")
-    with pytest.raises(ValueError, match="FUSED_MAX_NWIN"):
+    with pytest.raises(ValueError, match="fused_max_nwin"):
         jx.xcorr_traj_follow(data, t_axis, PIVOT, ch, t_at_ch,
                              nsamp_big, small_wlen, mode="fused")
 

@@ -559,7 +559,7 @@ def test_close_with_wedged_dispatcher_fails_pending_futures():
     gate = _Gate()
     eng = ServingEngine(FnComputeFactory(gate.build, "gated"),
                         ServeConfig(buckets=((8, 32),), max_batch=1,
-                                    batch_window_ms=0.0, warmup=False,
+                                    warmup=False,
                                     default_deadline_ms=600000.0)).start()
     f_wedged = eng.submit(_section(8, 32))
     assert gate.started.wait(timeout=10.0)     # dispatcher is now inside compute

@@ -1,0 +1,328 @@
+"""Tuner subsystem tests: store robustness, sweep mechanics, integration.
+
+The store contract under test is the warmup-safety one (ISSUE 19 satellite):
+persistence round-trips, a config-hash mismatch is a plain miss (re-tune,
+never stale winners), and a corrupt/truncated/foreign-version store file
+degrades to default knobs with a warning — no failure mode may crash a
+batch start or a serve warmup.  Sweeps run against stub timers (no real
+kernel timing in tier-1); the serve integration runs on the stub-factory
+pattern so zero fresh ``process_chunk`` programs are traced.
+"""
+
+import json
+import logging
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from das_diff_veh_tpu.config import (PipelineConfig, RingConfig, ServeConfig)
+from das_diff_veh_tpu.runtime import RuntimeConfig, consult_tuner
+from das_diff_veh_tpu.tune import (STORE_VERSION, KnobSpec, TunedEntry,
+                                   TunerStore, apply_winners, base_hash,
+                                   load_tuned, store_key, sweep_knobs, tune)
+
+
+# --------------------------------------------------------------------------
+# store: persistence + every failure mode degrades to defaults
+# --------------------------------------------------------------------------
+
+def test_store_round_trip(tmp_path):
+    p = str(tmp_path / "tuner.json")
+    s = TunerStore(p)
+    entry = TunedEntry({"ring.win_block": 16},
+                       {"baseline_s": 1.0, "tuned_s": 0.5})
+    s.record("cpu", "fiberA", "abcd1234", entry)
+    got = TunerStore(p).lookup("cpu", "fiberA", "abcd1234")
+    assert got is not None
+    assert got.winners == {"ring.win_block": 16}
+    assert got.meta["tuned_s"] == 0.5
+
+
+def test_store_miss_on_hash_backend_or_geometry_mismatch(tmp_path):
+    p = str(tmp_path / "tuner.json")
+    s = TunerStore(p)
+    s.record("cpu", "fiberA", "abcd1234", TunedEntry({"ring.win_block": 16}))
+    fresh = TunerStore(p)
+    assert fresh.lookup("cpu", "fiberA", "deadbeef") is None   # config changed
+    assert fresh.lookup("tpu", "fiberA", "abcd1234") is None   # other backend
+    assert fresh.lookup("cpu", "fiberB", "abcd1234") is None   # other geometry
+
+
+@pytest.mark.parametrize("content", [
+    "{not json",                                     # corrupt
+    "",                                              # truncated to nothing
+    json.dumps({"version": STORE_VERSION + 1,
+                "entries": {"cpu|g|h": {"winners": {}}}}),  # foreign version
+    json.dumps([1, 2, 3]),                           # wrong top-level type
+    json.dumps({"version": STORE_VERSION,
+                "entries": {"cpu|g|h": "not-a-dict"}}),     # malformed entry
+])
+def test_store_bad_file_warns_and_falls_back(tmp_path, caplog, content):
+    p = str(tmp_path / "tuner.json")
+    with open(p, "w") as f:
+        f.write(content)
+    with caplog.at_level(logging.WARNING, logger="das_diff_veh_tpu.tune"):
+        assert TunerStore(p).lookup("cpu", "g", "h") is None
+    assert any("falling back" in r.message for r in caplog.records)
+
+
+def test_store_missing_file_is_empty_no_warning(tmp_path, caplog):
+    with caplog.at_level(logging.WARNING, logger="das_diff_veh_tpu.tune"):
+        assert TunerStore(str(tmp_path / "absent.json")).lookup(
+            "cpu", "g", "h") is None
+    assert not caplog.records
+
+
+def test_load_tuned_never_raises_on_bad_store(tmp_path):
+    """The warmup entry point: any store problem returns defaults."""
+    p = str(tmp_path / "tuner.json")
+    with open(p, "w") as f:
+        f.write("\x00garbage")
+    cfg = PipelineConfig()
+    out, ring, entry = load_tuned(cfg, p, "g", backend="cpu")
+    assert out == cfg and entry is None
+
+
+# --------------------------------------------------------------------------
+# apply_winners: whitelist enforcement
+# --------------------------------------------------------------------------
+
+def test_apply_winners_dotted_paths_and_ring_root():
+    cfg, ring = apply_winners(
+        PipelineConfig(),
+        {"gather.fused_max_nwin": 128, "gather.dot_max_wlen": 512,
+         "ring.win_block": 16, "chunk_pipeline": "fused"},
+        RingConfig())
+    assert cfg.gather.fused_max_nwin == 128
+    assert cfg.gather.dot_max_wlen == 512
+    assert cfg.chunk_pipeline == "fused"
+    assert ring.win_block == 16
+
+
+def test_apply_winners_skips_non_whitelisted(caplog):
+    """Physics and precision knobs are never obeyed from a store."""
+    base = PipelineConfig()
+    with caplog.at_level(logging.WARNING, logger="das_diff_veh_tpu.tune"):
+        cfg, _ = apply_winners(base, {"gather.precision": "bf16",
+                                      "gather.wlen": 99.0,
+                                      "no.such.path": 1})
+    assert cfg == base
+    assert sum("not in the tunable whitelist" in r.message
+               for r in caplog.records) == 3
+
+
+def test_apply_winners_ring_knob_without_ring_is_skipped(caplog):
+    with caplog.at_level(logging.WARNING, logger="das_diff_veh_tpu.tune"):
+        cfg, ring = apply_winners(PipelineConfig(), {"ring.win_block": 16})
+    assert ring is None and cfg == PipelineConfig()
+    assert any("needs a RingConfig" in r.message for r in caplog.records)
+
+
+def test_knobspec_rejects_non_whitelisted_path():
+    with pytest.raises(ValueError, match="not a tunable knob"):
+        KnobSpec("gather.precision", ("bf16",))
+
+
+# --------------------------------------------------------------------------
+# base_hash: stable across apply, sensitive to physics
+# --------------------------------------------------------------------------
+
+def test_base_hash_stable_under_winner_application():
+    cfg = PipelineConfig()
+    tuned, _ = apply_winners(cfg, {"gather.fused_max_nwin": 128,
+                                   "chunk_pipeline": "fused"})
+    assert base_hash(tuned) == base_hash(cfg)
+
+
+def test_base_hash_changes_with_physics():
+    cfg = PipelineConfig()
+    other = cfg.replace(gather=cfg.gather.__class__(wlen=3.0))
+    assert base_hash(other) != base_hash(cfg)
+
+
+# --------------------------------------------------------------------------
+# sweep: greedy descent against stub timers
+# --------------------------------------------------------------------------
+
+def test_sweep_picks_fastest_candidate():
+    times = {None: 1.0, 8: 0.8, 16: 0.4, 32: 0.6}
+
+    def t(cfg, ring):
+        return times[ring.win_block]
+
+    entry = sweep_knobs(PipelineConfig(),
+                        [KnobSpec("ring.win_block", (8, 16, 32))],
+                        t, reps=2, ring=RingConfig())
+    assert entry.winners == {"ring.win_block": 16}
+    assert entry.meta["baseline_s"] == 1.0
+    assert entry.meta["tuned_s"] == 0.4
+    assert entry.meta["speedup"] == pytest.approx(2.5)
+
+
+def test_sweep_keeps_default_when_it_wins():
+    def t(cfg, ring):           # every candidate slower than the default
+        return 0.5 if ring.win_block is None else 1.0
+
+    entry = sweep_knobs(PipelineConfig(),
+                        [KnobSpec("ring.win_block", (8, 16))],
+                        t, reps=1, ring=RingConfig())
+    assert entry.winners == {}
+    assert entry.meta["speedup"] == pytest.approx(1.0)
+
+
+def test_sweep_is_greedy_across_knobs():
+    """Knob 2 is swept with knob 1's winner already applied."""
+    def t(cfg, ring):
+        base = 1.0 if ring.win_block != 16 else 0.5
+        # lag_tile_max=256 only helps once win_block=16 won
+        if ring.win_block == 16 and ring.lag_tile_max == 256:
+            base -= 0.2
+        return base
+
+    entry = sweep_knobs(PipelineConfig(),
+                        [KnobSpec("ring.win_block", (8, 16)),
+                         KnobSpec("ring.lag_tile_max", (256,))],
+                        t, reps=1, ring=RingConfig())
+    assert entry.winners == {"ring.win_block": 16, "ring.lag_tile_max": 256}
+
+
+def test_tune_hits_store_without_resweeping(tmp_path):
+    calls = []
+
+    def t(cfg, ring):
+        calls.append(1)
+        return 1.0 if ring.win_block is None else 0.5
+
+    store = TunerStore(str(tmp_path / "t.json"))
+    knobs = [KnobSpec("ring.win_block", (16,))]
+    _, ring1, e1 = tune(store, "cpu", "g", PipelineConfig(), knobs, t,
+                        reps=1, ring=RingConfig())
+    assert ring1.win_block == 16 and calls
+    n_sweep = len(calls)
+    _, ring2, e2 = tune(store, "cpu", "g", PipelineConfig(), knobs, t,
+                        reps=1, ring=RingConfig())
+    assert len(calls) == n_sweep        # no re-measurement on the hit
+    assert ring2.win_block == 16 and e2.winners == e1.winners
+    # a physics change is a miss -> re-sweep
+    other = PipelineConfig().replace(
+        gather=PipelineConfig().gather.__class__(wlen=3.0))
+    tune(store, "cpu", "g", other, knobs, t, reps=1, ring=RingConfig())
+    assert len(calls) > n_sweep
+
+
+# --------------------------------------------------------------------------
+# runtime integration: consult_tuner
+# --------------------------------------------------------------------------
+
+def test_consult_tuner_disabled_is_identity():
+    cfg = PipelineConfig()
+    out, entry = consult_tuner(cfg, RuntimeConfig())
+    assert out == cfg and entry is None
+
+
+def test_consult_tuner_applies_winners_and_changes_manifest_hash(tmp_path):
+    from das_diff_veh_tpu.runtime import config_hash
+    p = str(tmp_path / "t.json")
+    cfg = PipelineConfig()
+    TunerStore(p).record("cpu", "fiberA", base_hash(cfg),
+                         TunedEntry({"gather.fused_max_nwin": 128}))
+    rt = RuntimeConfig(tuner_store=p, tuner_geometry="fiberA")
+    out, entry = consult_tuner(cfg, rt)
+    assert entry is not None
+    assert out.gather.fused_max_nwin == 128
+    # the tuned knob participates in the resume-manifest hash: a tuned run
+    # and a default run never share manifest/state
+    assert config_hash(out) != config_hash(cfg)
+
+
+def test_consult_tuner_corrupt_store_is_identity(tmp_path):
+    p = str(tmp_path / "t.json")
+    with open(p, "w") as f:
+        f.write("{broken")
+    cfg = PipelineConfig()
+    out, entry = consult_tuner(cfg, RuntimeConfig(tuner_store=p))
+    assert out == cfg and entry is None
+
+
+# --------------------------------------------------------------------------
+# serve integration: tuned warmup keeps the zero-compile SLO
+# --------------------------------------------------------------------------
+
+def test_imaging_factory_applies_store_before_config_key(tmp_path):
+    from das_diff_veh_tpu.serve import ImagingComputeFactory
+    p = str(tmp_path / "t.json")
+    cfg = PipelineConfig()
+    TunerStore(p).record("cpu", "fiberA", base_hash(cfg),
+                         TunedEntry({"gather.dot_max_wlen": 512}))
+    default_f = ImagingComputeFactory(cfg)
+    tuned_f = ImagingComputeFactory(cfg, tuner_store=p,
+                                    tuner_geometry="fiberA")
+    assert tuned_f.cfg.gather.dot_max_wlen == 512
+    assert tuned_f.tuner_entry is not None
+    # tuned and default deployments must never share cache entries
+    assert tuned_f.config_key != default_f.config_key
+
+
+def test_imaging_factory_corrupt_store_never_crashes(tmp_path):
+    from das_diff_veh_tpu.serve import ImagingComputeFactory
+    p = str(tmp_path / "t.json")
+    with open(p, "w") as f:
+        f.write("\x00")
+    f = ImagingComputeFactory(PipelineConfig(), tuner_store=p)
+    assert f.tuner_entry is None
+    assert f.config_key == ImagingComputeFactory(PipelineConfig()).config_key
+
+
+def test_tuned_engine_warmup_zero_steady_state_compiles(tmp_path):
+    """cache_misses == 0 still holds with tuned values active: the factory
+    applies winners before config_key, so the warmed program IS the tuned
+    program (stub compute — no fresh process_chunk traces in tier-1)."""
+    from das_diff_veh_tpu.core.section import DasSection
+    from das_diff_veh_tpu.serve import FnComputeFactory, ServingEngine
+
+    p = str(tmp_path / "t.json")
+    cfg = PipelineConfig()
+    TunerStore(p).record("cpu", "fiberA", base_hash(cfg),
+                         TunedEntry({"gather.fused_max_nwin": 128}))
+    tuned_cfg, _, entry = load_tuned(cfg, p, "fiberA", backend="cpu")
+    assert entry is not None
+
+    def build(bucket):
+        def fn(section, valid, state):
+            d = np.asarray(section.data)[:valid[0], :valid[1]]
+            return float(d.sum()), state
+        return fn
+
+    factory = FnComputeFactory(build, f"tuned:{base_hash(tuned_cfg)}")
+    factory.tuner_entry = entry           # serve-side tuned provenance
+    eng = ServingEngine(factory, ServeConfig(buckets=((8, 32),))).start()
+    try:
+        sec = DasSection(np.ones((8, 32), np.float32),
+                         np.arange(8, dtype=np.float64) * 8.16,
+                         np.arange(32, dtype=np.float64) / 250.0)
+        for _ in range(3):
+            assert eng.process(sec, timeout=30) == 8 * 32
+        m = eng.metrics()
+        assert m["warmup_builds"] == 1
+        assert m["tuned_warmups"] == 1       # compile_cache logged the consult
+        assert m["cache_misses"] == 0        # the SLO holds with tuned knobs
+    finally:
+        eng.close()
+
+
+# --------------------------------------------------------------------------
+# satellite: batch_window_ms deprecation
+# --------------------------------------------------------------------------
+
+def test_batch_window_ms_non_default_warns():
+    with pytest.warns(DeprecationWarning, match="batch_window_ms"):
+        ServeConfig(batch_window_ms=5.0)
+
+
+def test_batch_window_ms_default_is_silent():
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        ServeConfig()
+        ServeConfig(batch_window_ms=2.0)
