@@ -61,12 +61,15 @@ zero steady-state traces; BENCH_FUSED_DURATION/REPS tune it.  A tuner entry
 ``das_diff_veh_tpu.tune`` API (store round-trip + hit proven), and a
 precision entry (``precision_*`` keys) A/Bs the dispersion transform at
 f32 vs bf16 (the rel-err is the portable evidence; the throughput delta is
-TPU-only).  Both are *selectable*: ``bench.py --json-only tune precision``
-runs just those entries and prints one ``bench_subset`` JSON line — the
-tuner and CI path that skips the full smoke sweep.  Opt-outs:
+TPU-only).  A fleet-inversion entry (``invert_fleet_*`` keys) A/Bs the
+serial per-target ``invert_multirun`` loop against the packed
+``invert_fleet`` one-program path with trace counts on the clock.  All
+three are *selectable*: ``bench.py --json-only tune precision
+invert_fleet`` runs just those entries and prints one ``bench_subset``
+JSON line — the tuner and CI path that skips the full smoke sweep.  Opt-outs:
 BENCH_SKIP_E2E / BENCH_SKIP_OBS / BENCH_SKIP_CHAOS / BENCH_SKIP_SERVE / BENCH_SKIP_SERVE_MESH / BENCH_SKIP_PALLAS / BENCH_SKIP_SHARDED /
 BENCH_SKIP_LONG / BENCH_SKIP_10K / BENCH_SKIP_FUSED / BENCH_SKIP_TUNE /
-BENCH_SKIP_PRECISION; BENCH_10K_SRC_CHUNK tunes the 10k
+BENCH_SKIP_PRECISION / BENCH_SKIP_INVERT_FLEET; BENCH_10K_SRC_CHUNK tunes the 10k
 source-chunk size (default 32 — see docs/PERF.md on the working-set effect).
 The full env-knob table lives in docs/PERF.md §"Bench env knobs".
 
@@ -202,9 +205,114 @@ def _bench_precision(extra: dict) -> None:
         "portable number, bound committed in tests/test_precision.py)")
 
 
+def _bench_invert_fleet(extra: dict) -> None:
+    """Serial-loop vs fleet-batched inversion A/B (``invert_fleet_*`` keys).
+
+    The legacy path bakes each curve set into a Python closure, so a
+    T-target loop over ``invert_multirun`` re-traces and re-compiles the
+    swarm/refine programs per target; ``invert_fleet`` packs the fleet and
+    runs ONE data-parameterized program regardless of T.  Both sides run
+    cold (compiles on the clock — compile amortization IS the product),
+    seeded to produce identical per-target searches, and their jaxpr trace
+    counts are recorded via the ``obs/xla_events`` listener.  CPU-smoke
+    budgets; the speedup is compile-dominated by design, matching the
+    fleet use case (thousands of bootstrap/time-lapse targets).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from das_diff_veh_tpu.inversion import (Curve, LayerBounds, ModelSpec,
+                                            LayeredModel,
+                                            density_gardner_linear,
+                                            invert_fleet, invert_multirun,
+                                            make_misfit_fn, phase_velocity,
+                                            vp_from_poisson)
+    from das_diff_veh_tpu.obs import xla_events
+    from das_diff_veh_tpu.obs.registry import MetricsRegistry
+
+    T = max(2, int(os.environ.get("BENCH_FLEET_TARGETS", 10)))
+    n_runs = 2
+    budget = dict(n_runs=n_runs, popsize=8, maxiter=8, n_refine_starts=2,
+                  n_refine_steps=6, n_grid=150)
+
+    vs = jnp.asarray([0.20, 0.40, 0.70], dtype=jnp.float64)
+    vp = vp_from_poisson(vs, 0.4375)
+    truth = LayeredModel(jnp.asarray([0.006, 0.02, 0.0]), vp, vs,
+                         density_gardner_linear(vp))
+    periods = jnp.linspace(0.05, 0.4, 12)
+    c0 = np.asarray(phase_velocity(periods, truth, mode=0, n_grid=400))
+    rng = np.random.default_rng(20)
+    curve_sets = [
+        [Curve(np.asarray(periods), c0 + rng.normal(0.0, 0.005, c0.shape),
+               mode=0, weight=1.0, uncertainty=0.01 * np.ones_like(c0))]
+        for _ in range(T)]
+    spec = ModelSpec(layers=(LayerBounds((0.002, 0.012), (0.1, 0.3)),
+                             LayerBounds((0.01, 0.04), (0.25, 0.55)),
+                             LayerBounds((0.02, 0.08), (0.5, 1.0))))
+
+    def watched(fn):
+        reg = MetricsRegistry()
+        watch = xla_events.install(reg)
+        t0 = time.perf_counter()
+        try:
+            out = fn()
+        finally:
+            xla_events.uninstall(reg)
+        return time.perf_counter() - t0, watch.traces, out
+
+    # serial legacy loop: fresh closure per target -> per-target retrace
+    def serial():
+        return [invert_multirun(spec, curve_sets[t], seed=t * n_runs,
+                                **budget) for t in range(T)]
+
+    # Both sides pay true compile costs: the persistent compilation cache
+    # would otherwise absorb the serial loop's per-target compiles on any
+    # rerun (the curve data is seeded, so the HLO repeats) and the A/B
+    # would measure cache history instead of compile amortization.
+    cache_was = bool(jax.config.jax_enable_compilation_cache)
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        t_serial, traces_serial, res_serial = watched(serial)
+
+        # fleet: one packed data-parameterized program for all T targets
+        t_fleet, traces_fleet, res_fleet = watched(
+            lambda: invert_fleet(spec, curve_sets, seed=0, **budget))
+        # steady state: a second fleet of the same shape must not retrace
+        t_fleet2, traces_steady, _ = watched(
+            lambda: invert_fleet(spec, curve_sets, seed=0, **budget))
+    finally:
+        jax.config.update("jax_enable_compilation_cache", cache_was)
+
+    # parity: the legacy closure re-scores every fleet best — the packed
+    # misfit must agree pointwise (deterministic; the end-to-end serial
+    # and fleet searches are equal-seeded but f32 swarm trajectories are
+    # chaotic, so only the pointwise number is a contract)
+    parity = max(
+        abs(float(make_misfit_fn(spec, curve_sets[t],
+                                 n_grid=150)(jnp.asarray(res_fleet.x_best[t])))
+            - float(res_fleet.misfit[t]))
+        for t in range(T))
+    quality = float(np.median(res_fleet.misfit
+                              - np.asarray([r.misfit for r in res_serial])))
+
+    extra["invert_fleet_targets"] = T
+    extra["invert_fleet_serial_s"] = round(t_serial, 3)
+    extra["invert_fleet_serial_s_per_target"] = round(t_serial / T, 3)
+    extra["invert_fleet_serial_traces"] = traces_serial
+    extra["invert_fleet_s"] = round(t_fleet, 3)
+    extra["invert_fleet_s_per_target"] = round(t_fleet / T, 3)
+    extra["invert_fleet_traces"] = traces_fleet
+    extra["invert_fleet_steady_s_per_target"] = round(t_fleet2 / T, 3)
+    extra["invert_fleet_steady_traces"] = traces_steady
+    extra["invert_fleet_speedup"] = round(t_serial / t_fleet, 3)
+    extra["invert_fleet_packed_vs_closure_absdiff"] = parity
+    extra["invert_fleet_quality_delta_vs_serial"] = round(quality, 4)
+
+
 ENTRIES = {
     "tune": _bench_tune,
     "precision": _bench_precision,
+    "invert_fleet": _bench_invert_fleet,
 }
 
 

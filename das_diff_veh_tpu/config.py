@@ -574,6 +574,32 @@ class MeshServeConfig:
 
 
 @dataclass(frozen=True)
+class FleetInversionConfig:
+    """Fleet-inversion batch-size knobs (``fleet.*``).
+
+    Host-chunking for :func:`das_diff_veh_tpu.inversion.fleet.invert_fleet`:
+    how the (targets x runs x pop) working set is cut so big fleets stay
+    inside HBM.  Execution knobs, not physics — every chunking produces the
+    same inverted profiles to restart-fusion tolerance (pinned by
+    tests/test_fleet_inversion.py), so all three are tuner-sweepable
+    (``tune.TUNABLE_KNOBS``).
+    """
+
+    target_chunk: int = 0
+    """Targets inverted per device dispatch (0 = the whole fleet at once).
+    Every chunk is padded to this size so each hits the same compiled
+    program; with a mesh it is rounded up to a device-count multiple."""
+
+    eval_chunk: int = 0
+    """Per-target swarm-evaluation chunk handed to the inner
+    ``lax.map``-chunked population eval (0 = whole population at once)."""
+
+    refine_chunk: int = 0
+    """Multi-start refinement starts per dispatch inside the fleet's
+    Adam-polish stage (0 = all starts at once)."""
+
+
+@dataclass(frozen=True)
 class PipelineConfig:
     """Everything, bundled. Static under jit."""
 
@@ -589,6 +615,7 @@ class PipelineConfig:
     imaging: ImagingConfig = field(default_factory=ImagingConfig)
     bootstrap: BootstrapConfig = field(default_factory=BootstrapConfig)
     health: HealthConfig = field(default_factory=HealthConfig)
+    fleet: FleetInversionConfig = field(default_factory=FleetInversionConfig)
     max_windows: int = 64             # static per-chunk window capacity
 
     chunk_pipeline: str = "staged"

@@ -9,6 +9,11 @@ kernels.
 from das_diff_veh_tpu.inversion.curves import (Curve, curves_from_ridges,
                                                load_reference_ridge_npz,
                                                ridge_stats)
+from das_diff_veh_tpu.inversion.fleet import (CurveBatch, FleetResult,
+                                              VsShiftEvent, detect_vs_shifts,
+                                              invert_fleet,
+                                              make_packed_misfit_fn,
+                                              pack_curve_sets)
 from das_diff_veh_tpu.inversion.forward import (LayeredModel,
                                                 density_gardner_linear,
                                                 phase_velocity,
@@ -31,6 +36,8 @@ __all__ = [
     "secular", "vp_from_poisson",
     "InversionResult", "LayerBounds", "ModelSpec", "invert",
     "invert_multirun", "make_misfit_fn",
+    "CurveBatch", "FleetResult", "VsShiftEvent", "detect_vs_shifts",
+    "invert_fleet", "make_packed_misfit_fn", "pack_curve_sets",
     "speed_model_spec", "weight_model_spec",
     "SensitivityKernel", "phase_sensitivity", "resample_fine",
 ]

@@ -1,0 +1,434 @@
+"""Fleet inversion: ONE compiled program inverts T curve sets at once.
+
+The closure path (:func:`~das_diff_veh_tpu.inversion.invert.make_misfit_fn`)
+bakes each curve set into a Python closure — concatenated arrays captured
+by value, per-curve RMSE recovered by Python-level static slices — so every
+new target re-traces and re-compiles the jitted swarm/refine executables
+(keyed on the closure's identity) and a bootstrap/time-lapse fleet runs
+serially.  This module makes the misfit *data-parameterized* instead:
+
+* :func:`pack_curve_sets` pads T ragged curve sets into ``(T, max_pts)``
+  period/velocity/uncertainty/mode tensors with a validity mask and
+  per-point curve-segment ids (:class:`CurveBatch`);
+* :func:`make_packed_misfit_fn` builds ``misfit(x01, curve_batch)`` where
+  per-curve RMSE is a masked segment reduction (``jax.ops.segment_sum``
+  with a static segment count) — numerically the same objective as the
+  closure, but the observations are *traced operands*, so one traced
+  function serves every curve set with the same (geometry, budget);
+* :func:`invert_fleet` stacks a target-axis ``vmap`` on top of
+  :func:`~das_diff_veh_tpu.inversion.invert.invert_multirun`'s run-axis
+  ``vmap``, shards the target axis over an optional device mesh (same
+  NamedSharding pattern as the multirun run axis), and host-chunks the
+  (targets x runs x pop) working set through ``target_chunk`` /
+  ``eval_chunk`` / ``refine_chunk`` so big fleets stay inside HBM.
+
+On top of the batched ensemble, :class:`FleetResult` carries per-target
+credible intervals from the pooled multi-start population (deep-ensembles
+style — Lakshminarayanan et al., NeurIPS 2017: independently-initialised
+restarts as an ensemble posterior; PAPERS.md), and
+:func:`detect_vs_shifts` turns a (baseline, current) result pair into
+change-detection events for the obs registry
+(``pipeline.timelapse.FleetVsMonitor``).
+
+Parity contract: the packed misfit must agree with the closure oracle on
+the same curves (pinned in tests/test_fleet_inversion.py, including at the
+committed ``INVERSION_PARITY.json`` best models), and the credible-interval
+machinery never touches best-model selection — uncertainty can only
+annotate, never loosen, a misfit.
+
+Seeding contract: fleet target ``t`` run ``r`` uses
+``PRNGKey(seed + t * n_runs + r)``, i.e. target ``t`` reproduces
+``invert_multirun(..., seed=seed + t * n_runs)`` exactly (same init, same
+``fold_in`` chunk stream) — the per-target equivalence tests rely on it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from das_diff_veh_tpu.inversion.curves import Curve
+from das_diff_veh_tpu.inversion.forward import phase_velocity
+from das_diff_veh_tpu.inversion.invert import (INVALID_RESIDUAL, ModelSpec,
+                                               _misfit_batch, _pso_init,
+                                               _pso_run, _refine_run)
+
+
+class CurveBatch(NamedTuple):
+    """T ragged curve sets packed into padded, maskable tensors.
+
+    Leading axes are arbitrary (the fleet engine carries ``(T, ...)`` and
+    vmaps the target axis away); trailing axes are ``P`` points
+    (``period``..``segment``) and ``S`` curve slots (``weight``).  Padding
+    points carry ``valid=False``, a benign period (1.0 s) and segment 0 —
+    they are masked out of every reduction; padding curve slots carry
+    weight 0.  ``wsum`` is the per-target sum of real curve weights (the
+    closure's weight normaliser)."""
+
+    period: jnp.ndarray        # (..., P) seconds, padded with 1.0
+    velocity: jnp.ndarray      # (..., P) km/s
+    uncertainty: jnp.ndarray   # (..., P) km/s, padded with 1.0
+    mode: jnp.ndarray          # (..., P) int32 modal order, padded with 0
+    valid: jnp.ndarray         # (..., P) bool point-validity mask
+    segment: jnp.ndarray       # (..., P) int32 curve id in [0, S)
+    weight: jnp.ndarray        # (..., S) per-curve weights, padded with 0
+    wsum: jnp.ndarray          # (...,)   sum of real weights
+
+    @property
+    def n_targets(self) -> int:
+        return self.period.shape[0]
+
+    @property
+    def n_curves(self) -> int:
+        return self.weight.shape[-1]
+
+
+def pack_curve_sets(curve_sets: Sequence[Sequence[Curve]], dtype=None,
+                    max_points: Optional[int] = None,
+                    max_curves: Optional[int] = None) -> CurveBatch:
+    """Pack T ragged curve sets into one padded :class:`CurveBatch`.
+
+    ``max_points``/``max_curves`` pin the padded capacity (they must cover
+    the largest set); fixing them across fleets keeps the packed shapes —
+    and therefore the compiled fleet programs — identical between calls.
+    ``dtype`` pins the float dtype of the packed observations (None =
+    default float, matching :func:`make_misfit_fn`'s ``dtype=None``)."""
+    if not curve_sets:
+        raise ValueError("pack_curve_sets needs at least one curve set")
+    counts = [[int(np.asarray(c.period).shape[0]) for c in cs]
+              for cs in curve_sets]
+    if any(len(c) == 0 for c in counts):
+        raise ValueError("every curve set needs at least one curve")
+    p_need = max(sum(cnt) for cnt in counts)
+    s_need = max(len(cnt) for cnt in counts)
+    P = p_need if max_points is None else int(max_points)
+    S = s_need if max_curves is None else int(max_curves)
+    if P < p_need or S < s_need:
+        raise ValueError(f"capacity ({P} pts, {S} curves) below largest "
+                         f"set ({p_need} pts, {s_need} curves)")
+    T = len(curve_sets)
+    per = np.ones((T, P))
+    vel = np.zeros((T, P))
+    unc = np.ones((T, P))
+    mode = np.zeros((T, P), dtype=np.int32)
+    valid = np.zeros((T, P), dtype=bool)
+    seg = np.zeros((T, P), dtype=np.int32)
+    w = np.zeros((T, S))
+    for t, cs in enumerate(curve_sets):
+        o = 0
+        for i, c in enumerate(cs):
+            p = np.asarray(c.period, dtype=np.float64)
+            n = p.shape[0]
+            per[t, o:o + n] = p
+            vel[t, o:o + n] = np.asarray(c.velocity, dtype=np.float64)
+            unc[t, o:o + n] = (np.asarray(c.uncertainty, dtype=np.float64)
+                               if c.uncertainty is not None else 1.0)
+            mode[t, o:o + n] = int(c.mode)
+            valid[t, o:o + n] = True
+            seg[t, o:o + n] = i
+            w[t, i] = float(c.weight)
+            o += n
+    return CurveBatch(period=jnp.asarray(per, dtype),
+                      velocity=jnp.asarray(vel, dtype),
+                      uncertainty=jnp.asarray(unc, dtype),
+                      mode=jnp.asarray(mode),
+                      valid=jnp.asarray(valid),
+                      segment=jnp.asarray(seg),
+                      weight=jnp.asarray(w, dtype),
+                      wsum=jnp.asarray(w.sum(axis=1), dtype))
+
+
+@functools.lru_cache(maxsize=64)
+def make_packed_misfit_fn(spec: ModelSpec, n_grid: int = 400,
+                          n_subdiv: int = 1, invalid: str = "penalty"):
+    """``misfit(x01, curve_batch) -> scalar`` for ONE target's packed set.
+
+    Numerically the closure objective of :func:`make_misfit_fn` — evodcinv
+    'rmse': per curve ``sqrt(mean(((obs-pred)/unc)^2))``, weight-normalised
+    sum; below-cutoff handling per ``invalid`` ("penalty": fixed
+    INVALID_RESIDUAL per missing point; "truncate": missing points drop
+    from the per-curve mean) — but with the observations as traced operands
+    and the per-curve reduction as a masked ``segment_sum`` over static
+    segment count, so one traced function (and one jitted swarm/refine
+    executable keyed on it) serves every curve set of a given padded shape.
+
+    lru-cached on ``(spec, n_grid, n_subdiv, invalid)``: repeated fleets
+    with the same geometry/budget get the SAME function object, which is
+    what keeps the jit caches warm across calls (the one-program
+    amortization the bench entry measures)."""
+    assert invalid in ("penalty", "truncate")
+
+    def misfit(x01, cb: CurveBatch):
+        model = spec.to_model(x01)
+        pred = phase_velocity(cb.period, model, mode=cb.mode,
+                              n_grid=n_grid, n_subdiv=n_subdiv)
+        fin = jnp.isfinite(pred) & cb.valid
+        r = (cb.velocity - pred) / cb.uncertainty
+        r = jnp.where(fin, r, INVALID_RESIDUAL)   # below-cutoff -> penalty
+        r = jnp.where(cb.valid, r, 0.0)           # padding contributes 0
+        n_seg = cb.weight.shape[-1]
+        one = jnp.ones_like(r)
+        zero = jnp.zeros_like(r)
+        npts = jax.ops.segment_sum(jnp.where(cb.valid, one, zero),
+                                   cb.segment, n_seg)
+        if invalid == "truncate":
+            n_fin = jax.ops.segment_sum(jnp.where(fin, one, zero),
+                                        cb.segment, n_seg)
+            ss = jax.ops.segment_sum(jnp.where(fin, r * r, zero),
+                                     cb.segment, n_seg)
+            rmse = jnp.sqrt(ss / jnp.maximum(n_fin, 1.0))
+            rmse = jnp.where(n_fin > 0, rmse, INVALID_RESIDUAL)
+            # padding curve slots (npts == 0) carry weight 0 anyway; zero
+            # them so 0 * INVALID_RESIDUAL can never leak through a NaN
+            rmse = jnp.where(npts > 0, rmse, 0.0)
+        else:
+            ss = jax.ops.segment_sum(r * r, cb.segment, n_seg)
+            rmse = jnp.sqrt(ss / jnp.maximum(npts, 1.0))
+        return jnp.sum(cb.weight * rmse) / cb.wsum
+
+    return misfit
+
+
+class FleetResult(NamedTuple):
+    """Per-target best models + pooled-ensemble credible intervals.
+
+    All fields are HOST numpy arrays with a leading target axis ``T`` (the
+    fleet engine pulls each target chunk in one ``device_get``).  The
+    interval fields come from the pooled multi-start ensemble (population +
+    refined members with misfit within ``credible_factor`` of the target's
+    best) — deep-ensembles style; they are widened to always contain the
+    best model's profile, and computing them never alters which member is
+    selected as best ("uncertainty never loosens misfits")."""
+
+    x_best: np.ndarray       # (T, n_params) unit-cube best model
+    misfit: np.ndarray       # (T,)
+    thickness: np.ndarray    # (T, n_layers) km
+    vs: np.ndarray           # (T, n_layers) km/s best-model profile
+    vs_lo: np.ndarray        # (T, n_layers) lower credible bound
+    vs_med: np.ndarray       # (T, n_layers) ensemble median
+    vs_hi: np.ndarray        # (T, n_layers) upper credible bound
+    n_ensemble: np.ndarray   # (T,) members inside the credible cut
+    models_x: np.ndarray     # (T, M, n_params) pooled pop + refined
+    misfits: np.ndarray      # (T, M)
+    history: np.ndarray      # (T, maxiter) best-so-far misfit trace
+
+
+class VsShiftEvent(NamedTuple):
+    """One layer of one target drifted outside the baseline interval."""
+
+    target: int
+    layer: int
+    vs: float        # current best-model Vs (km/s)
+    lo: float        # baseline interval bounds it escaped
+    hi: float
+
+
+def detect_vs_shifts(baseline: FleetResult,
+                     current: FleetResult) -> list[VsShiftEvent]:
+    """Change detection: layers whose current best Vs falls outside the
+    BASELINE's credible interval.  Pure function of two results; the obs
+    wiring (counter/alarm/flight record) lives in
+    ``pipeline.timelapse.FleetVsMonitor``."""
+    if baseline.vs.shape != current.vs.shape:
+        raise ValueError(f"baseline/current fleet shapes differ: "
+                         f"{baseline.vs.shape} vs {current.vs.shape}")
+    out = (current.vs < baseline.vs_lo) | (current.vs > baseline.vs_hi)
+    events = []
+    for t, layer in zip(*np.nonzero(out)):
+        events.append(VsShiftEvent(
+            target=int(t), layer=int(layer),
+            vs=float(current.vs[t, layer]),
+            lo=float(baseline.vs_lo[t, layer]),
+            hi=float(baseline.vs_hi[t, layer])))
+    return events
+
+
+def _ensemble_intervals(spec: ModelSpec, models_x: np.ndarray,
+                        misfits: np.ndarray, factor: float,
+                        q: tuple[float, float]):
+    """Per-layer Vs quantiles over the credible members of each target's
+    pooled ensemble.  Members qualify when their misfit is finite and
+    within ``factor`` x the target's best (the best member always
+    qualifies, so ``n_ensemble >= 1``)."""
+    lo_b, hi_b = (np.asarray(a, dtype=np.float64)
+                  for a in spec.bounds_arrays())
+    n = spec.n_layers
+    x = lo_b + (hi_b - lo_b) * np.clip(models_x, 0.0, 1.0)
+    vs_all = x[..., n:2 * n]                              # (T, M, L)
+    best = np.nanmin(np.where(np.isfinite(misfits), misfits, np.inf),
+                     axis=1, keepdims=True)
+    sel = np.isfinite(misfits) & (misfits <= factor * best)
+    v = np.where(sel[..., None], vs_all, np.nan)
+    lo_q = np.nanquantile(v, q[0], axis=1)
+    med = np.nanquantile(v, 0.5, axis=1)
+    hi_q = np.nanquantile(v, q[1], axis=1)
+    return lo_q, med, hi_q, sel.sum(axis=1)
+
+
+def invert_fleet(spec: ModelSpec,
+                 curve_sets: Optional[Sequence[Sequence[Curve]]] = None, *,
+                 batch: Optional[CurveBatch] = None, n_runs: int = 2,
+                 popsize: int = 50, maxiter: int = 200,
+                 n_refine_starts: int = 8, n_refine_steps: int = 80,
+                 n_grid: int = 400, n_subdiv: int = 1, dtype=None,
+                 invalid: str = "penalty", seed: int = 0, chunk: int = 50,
+                 eval_chunk: int = 0, refine_chunk: int = 0,
+                 target_chunk: int = 0, credible_factor: float = 2.0,
+                 credible_q: tuple[float, float] = (0.05, 0.95),
+                 x0=None, mesh=None, mesh_axis: str = "win") -> FleetResult:
+    """Invert T curve sets as one target-axis-vmapped, mesh-shardable
+    computation: ONE XLA program per (geometry, budget) regardless of T.
+
+    Parameters mirror :func:`invert_multirun` per target, plus:
+
+    ``batch``: a prebuilt :class:`CurveBatch` (e.g. from
+    :func:`pack_curve_sets` with pinned capacities) instead of
+    ``curve_sets``; passing the same padded shapes across calls reuses the
+    compiled programs.
+
+    ``target_chunk``: host-chunks the target axis (0 = all targets in one
+    device program).  Chunks are padded to a fixed size (by repeating a
+    real target, later dropped), so every chunk runs the SAME compiled
+    program — the program count is invariant in T.
+
+    ``credible_factor``/``credible_q``: pooled-ensemble credible cut and
+    quantiles for the per-target Vs intervals (see :class:`FleetResult`).
+
+    ``mesh``: shards the *target* axis over ``mesh_axis`` (each device
+    inverts its own targets; targets are independent so results match the
+    unsharded run to cross-restart-fusion tolerance).  The padded chunk
+    size is rounded up to a device-count multiple for even placement.
+    """
+    if batch is None:
+        if curve_sets is None:
+            raise ValueError("pass curve_sets or a packed batch")
+        batch = pack_curve_sets(curve_sets, dtype=dtype)
+    misfit_fn = make_packed_misfit_fn(spec, n_grid=n_grid,
+                                      n_subdiv=n_subdiv, invalid=invalid)
+    T = batch.n_targets
+    tc = target_chunk if (target_chunk and target_chunk < T) else T
+    if mesh is not None:
+        ndev = int(mesh.shape[mesh_axis])
+        tc = -(-tc // ndev) * ndev          # round up to a device multiple
+
+    def _shard_targets(tree):
+        if mesh is None:
+            return tree
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def place(a):
+            spec_ = P(*((mesh_axis,) + (None,) * (a.ndim - 1)))
+            return jax.device_put(a, NamedSharding(mesh, spec_))
+
+        return jax.tree.map(place, tree)
+
+    if x0 is not None:
+        x0 = jnp.asarray(np.asarray(x0, dtype=np.float64), dtype)
+    init = functools.partial(
+        _pso_init, misfit_fn, n_params=spec.n_params, popsize=popsize,
+        dtype=dtype, eval_chunk=eval_chunk, x0=x0)
+
+    chunks = []
+    for start in range(0, T, tc):
+        # fixed-size chunk: pad by repeating the chunk's first target
+        # (dropped after device_get), so every chunk hits the same program
+        sel = np.arange(start, start + tc)
+        sel = np.where(sel < T, sel, start)
+        keep = tc if start + tc <= T else T - start
+        # numpy gather: eager jax indexing would trace tc-dependent
+        # index-normalization programs, breaking the T-invariant trace count
+        data = jax.tree.map(lambda a: jnp.asarray(np.asarray(a)[sel]), batch)
+        seeds = seed + sel[:, None] * n_runs + np.arange(n_runs)[None, :]
+        keys = jax.vmap(jax.vmap(jax.random.PRNGKey))(jnp.asarray(seeds))
+        keys = _shard_targets(keys)
+        data = _shard_targets(data)
+        states = _shard_targets(jax.vmap(
+            lambda ks, d: jax.vmap(lambda k: init(k, d))(ks))(keys, data))
+        traces, done = [], 0
+        while done < maxiter:
+            n = min(chunk, maxiter - done)
+            step_keys = jax.vmap(jax.vmap(
+                lambda k: jax.random.fold_in(k, 7 + done)))(keys)
+            states, tr = jax.vmap(
+                lambda st, ks, d: jax.vmap(
+                    lambda s, k: _pso_run(misfit_fn, s, k, n,
+                                          eval_chunk=eval_chunk,
+                                          data=d))(st, ks))(
+                states, step_keys, data)
+            traces.append(tr)                         # (tc, n_runs, n)
+            done += n
+        _, _, pop_x, pop_f, gbest_x, gbest_f = states  # (tc, runs, pop, ..)
+
+        k = min(n_refine_starts, popsize)
+        top = jnp.argsort(pop_f, axis=2)[..., :k]      # (tc, runs, k)
+        starts = jnp.concatenate(
+            [gbest_x[:, :, None],
+             jnp.take_along_axis(pop_x, top[..., None], axis=2)],
+            axis=2).reshape(tc, -1, spec.n_params)     # per-target pooled
+        ref_x, ref_f = _refine_fleet(misfit_fn, starts, data,
+                                     n_refine_steps,
+                                     refine_chunk=refine_chunk)
+
+        all_x = jnp.concatenate(
+            [pop_x.reshape(tc, -1, spec.n_params), ref_x], axis=1)
+        all_f = jnp.concatenate([pop_f.reshape(tc, -1), ref_f], axis=1)
+        hist = jnp.min(jnp.concatenate(traces, axis=-1), axis=1)
+        ax, af, ah = jax.device_get((all_x, all_f, hist))
+        chunks.append((ax[:keep], af[:keep], ah[:keep]))
+
+    models_x = np.concatenate([c[0] for c in chunks], axis=0)
+    misfits = np.concatenate([c[1] for c in chunks], axis=0)
+    history = np.concatenate([c[2] for c in chunks], axis=0)
+
+    best = np.argmin(misfits, axis=1)
+    x_best = np.take_along_axis(
+        models_x, best[:, None, None], axis=1)[:, 0]
+    misfit_best = np.take_along_axis(misfits, best[:, None], axis=1)[:, 0]
+    lo_b, hi_b = (np.asarray(a, dtype=np.float64)
+                  for a in spec.bounds_arrays())
+    xb = lo_b + (hi_b - lo_b) * np.clip(x_best, 0.0, 1.0)
+    nl = spec.n_layers
+    thickness, vs = xb[:, :nl], xb[:, nl:2 * nl]
+    vs_lo, vs_med, vs_hi, n_ens = _ensemble_intervals(
+        spec, models_x, misfits, credible_factor, credible_q)
+    # the interval always contains the shipped best profile (a best model
+    # at an extreme quantile would otherwise sit outside its own interval
+    # and every epoch would false-alarm against itself)
+    vs_lo = np.minimum(vs_lo, vs)
+    vs_hi = np.maximum(vs_hi, vs)
+    return FleetResult(x_best=x_best, misfit=misfit_best,
+                       thickness=thickness, vs=vs, vs_lo=vs_lo,
+                       vs_med=vs_med, vs_hi=vs_hi, n_ensemble=n_ens,
+                       models_x=models_x, misfits=misfits, history=history)
+
+
+def _refine_fleet(misfit_fn, starts, data, n_steps: int, lr: float = 0.02,
+                  step_chunk: int = 50, refine_chunk: int = 0):
+    """Per-target pooled multi-start Adam refinement with a target axis:
+    the fleet-shaped twin of :func:`invert._refine` (same logit-space
+    iteration, same host chunking over steps and starts)."""
+    eps = 1e-4
+    z = jax.scipy.special.logit(jnp.clip(starts, eps, 1.0 - eps))
+    S = z.shape[1]
+    rc = refine_chunk if (refine_chunk and refine_chunk < S) else S
+    xs, fs = [], []
+    for i in range(0, S, rc):
+        zi = z[:, i:i + rc]
+        opt_state = jax.vmap(jax.vmap(optax.adam(lr).init))(zi)
+        done = 0
+        while done < n_steps:
+            n = min(step_chunk, n_steps - done)
+            zi, opt_state = jax.vmap(
+                lambda zz, oo, dd: _refine_run(misfit_fn, zz, oo, n, lr,
+                                               data=dd))(zi, opt_state, data)
+            done += n
+        xi = jax.nn.sigmoid(zi)
+        xs.append(xi)
+        fs.append(jax.vmap(
+            lambda xx, dd: _misfit_batch(misfit_fn, xx, dd))(xi, data))
+    return jnp.concatenate(xs, axis=1), jnp.concatenate(fs, axis=1)

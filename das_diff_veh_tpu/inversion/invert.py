@@ -21,6 +21,7 @@ penalty residual instead of NaN so the objective stays finite.
 
 from __future__ import annotations
 
+import weakref
 from functools import partial
 from typing import Callable, NamedTuple, Sequence
 
@@ -192,25 +193,59 @@ class InversionResult(NamedTuple):
     history: jnp.ndarray       # (iters,) best-so-far misfit trace
 
 
-def _eval_pop(misfit_fn, x, eval_chunk: int):
+# legacy misfit(x01) closure -> misfit(x01, data) adapter, cached by the
+# closure's identity: the jitted swarm/refine executables are keyed on the
+# (static) misfit function object, so handing the SAME closure back must
+# produce the SAME adapter or every call would re-trace.
+_data_adapters: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _as_data_misfit(fn):
+    """Adapt a single-argument misfit closure (from :func:`make_misfit_fn`)
+    to the internal data-parameterized signature ``misfit(x01, data)``.
+
+    The closure path bakes its observations into the function, so ``data``
+    is simply ignored (``None`` flows through the jitted helpers as an
+    empty pytree).  The fleet engine (``inversion.fleet``) instead passes a
+    packed :class:`~das_diff_veh_tpu.inversion.fleet.CurveBatch` as
+    ``data`` — one traced function for every curve set."""
+    try:
+        adapter = _data_adapters.get(fn)
+    except TypeError:                      # unhashable/unweakrefable callable
+        adapter = None
+    if adapter is None:
+        def adapter(x01, data, _fn=fn):
+            del data                       # baked into the closure
+            return _fn(x01)
+        try:
+            _data_adapters[fn] = adapter
+        except TypeError:
+            pass
+    return adapter
+
+
+def _eval_pop(misfit_fn, x, data, eval_chunk: int):
     """Population misfits; ``eval_chunk > 0`` bounds how many evaluate
     concurrently (lax.map over chunks) so batched-restart populations can't
     exceed device memory — an outer run-axis vmap turns the chunk loop into
-    a (runs x eval_chunk) working set instead of (runs x popsize)."""
+    a (runs x eval_chunk) working set instead of (runs x popsize).
+
+    ``misfit_fn(x01, data)``: ``data`` broadcasts across the population
+    (closure path: None; fleet path: this target's packed curve set)."""
     pop = x.shape[0]
+    one = jax.vmap(lambda xx: misfit_fn(xx, data))
     if eval_chunk <= 0 or eval_chunk >= pop:
-        return jax.vmap(misfit_fn)(x)
+        return one(x)
     pad = (-pop) % eval_chunk
     xp = jnp.pad(x, ((0, pad), (0, 0)))
-    f = jax.lax.map(jax.vmap(misfit_fn),
-                    xp.reshape(-1, eval_chunk, x.shape[-1]))
+    f = jax.lax.map(one, xp.reshape(-1, eval_chunk, x.shape[-1]))
     return f.reshape(-1)[:pop]
 
 
 @partial(jax.jit, static_argnames=("misfit_fn", "n_params", "popsize",
                                    "dtype", "eval_chunk"))
-def _pso_init(misfit_fn, key, n_params: int, popsize: int, dtype=None,
-              eval_chunk: int = 0, x0=None):
+def _pso_init(misfit_fn, key, data=None, *, n_params: int, popsize: int,
+              dtype=None, eval_chunk: int = 0, x0=None):
     dtype = dtype or jnp.zeros(()).dtype
     k1, k2 = jax.random.split(key)
     x = jax.random.uniform(k1, (popsize, n_params), dtype=dtype)
@@ -220,13 +255,14 @@ def _pso_init(misfit_fn, key, n_params: int, popsize: int, dtype=None,
         m = min(x0.shape[0], popsize)
         x = x.at[:m].set(jnp.clip(jnp.asarray(x0[:m], dtype), 0.0, 1.0))
     v = 0.1 * (jax.random.uniform(k2, (popsize, n_params), dtype=dtype) - 0.5)
-    f = _eval_pop(misfit_fn, x, eval_chunk)
+    f = _eval_pop(misfit_fn, x, data, eval_chunk)
     g = jnp.argmin(f)
     return (x, v, x, f, x[g], f[g])
 
 
 @partial(jax.jit, static_argnames=("misfit_fn", "n_iters", "eval_chunk"))
-def _pso_run(misfit_fn, state, key, n_iters: int, eval_chunk: int = 0):
+def _pso_run(misfit_fn, state, key, n_iters: int, eval_chunk: int = 0,
+             data=None):
     """``n_iters`` inertial global-best PSO steps on the unit cube (w=0.73,
     c1=c2=1.496 - the constriction coefficients the reference's stochopy
     CPSO also defaults to), velocities clamped, positions clipped."""
@@ -241,7 +277,7 @@ def _pso_run(misfit_fn, state, key, n_iters: int, eval_chunk: int = 0):
              + c2 * r1[1] * (gbest_x[None] - x))
         v = jnp.clip(v, -0.25, 0.25)
         x = jnp.clip(x + v, 0.0, 1.0)
-        f = _eval_pop(misfit_fn, x, eval_chunk)
+        f = _eval_pop(misfit_fn, x, data, eval_chunk)
         better = f < pbest_f
         pbest_x = jnp.where(better[:, None], x, pbest_x)
         pbest_f = jnp.where(better, f, pbest_f)
@@ -256,14 +292,14 @@ def _pso_run(misfit_fn, state, key, n_iters: int, eval_chunk: int = 0):
 
 
 @partial(jax.jit, static_argnames=("misfit_fn", "n_steps", "lr"))
-def _refine_run(misfit_fn, z, opt_state, n_steps: int, lr: float):
+def _refine_run(misfit_fn, z, opt_state, n_steps: int, lr: float, data=None):
     opt = optax.adam(lr)
 
     def one(z, opt_state):
         def body(carry, _):
             z, state = carry
             loss, grad = jax.value_and_grad(
-                lambda zz: misfit_fn(jax.nn.sigmoid(zz)))(z)
+                lambda zz: misfit_fn(jax.nn.sigmoid(zz), data))(z)
             grad = jnp.where(jnp.isfinite(grad), grad, 0.0)
             updates, state = opt.update(grad, state)
             return (optax.apply_updates(z, updates), state), loss
@@ -271,11 +307,12 @@ def _refine_run(misfit_fn, z, opt_state, n_steps: int, lr: float):
                                      length=n_steps)
         return z, state
 
+    # ``data`` is closed over, so it broadcasts across the start axis
     return jax.vmap(one)(z, opt_state)
 
 
 def _refine(misfit_fn, x0_batch, n_steps: int, lr: float = 0.02,
-            chunk: int = 50):
+            chunk: int = 50, data=None):
     """Vectorised multi-start Adam in logit space (keeps iterates strictly
     inside the box while gradients stay unconstrained).  Host-chunked like
     the PSO loop in :func:`invert_multirun` to bound single device-call
@@ -286,15 +323,15 @@ def _refine(misfit_fn, x0_batch, n_steps: int, lr: float = 0.02,
     done = 0
     while done < n_steps:
         n = min(chunk, n_steps - done)
-        z, opt_state = _refine_run(misfit_fn, z, opt_state, n, lr)
+        z, opt_state = _refine_run(misfit_fn, z, opt_state, n, lr, data)
         done += n
     x = jax.nn.sigmoid(z)
-    return x, _misfit_batch(misfit_fn, x)
+    return x, _misfit_batch(misfit_fn, x, data)
 
 
 @partial(jax.jit, static_argnames=("misfit_fn",))
-def _misfit_batch(misfit_fn, x):
-    return jax.vmap(misfit_fn)(x)
+def _misfit_batch(misfit_fn, x, data=None):
+    return jax.vmap(lambda xx: misfit_fn(xx, data))(x)
 
 
 def invert(spec: ModelSpec, curves: Sequence[Curve], *, popsize: int = 50,
@@ -363,6 +400,7 @@ def invert_multirun(spec: ModelSpec, curves: Sequence[Curve], *,
         misfit_fn = make_misfit_fn(spec, curves, n_grid=n_grid,
                                    n_subdiv=n_subdiv, dtype=dtype,
                                    invalid=invalid)
+    misfit_fn = _as_data_misfit(misfit_fn)
     keys = jax.vmap(jax.random.PRNGKey)(seed + jnp.arange(n_runs))
 
     def _shard_runs(tree):

@@ -47,10 +47,16 @@ TUNABLE_KNOBS = frozenset({
     "ring.lag_tile_max",
     # per-chunk pipeline dispatch mode
     "chunk_pipeline",
+    # fleet inversion: batch-size knobs (FleetInversionConfig)
+    "fleet.target_chunk",
+    "fleet.eval_chunk",
+    "fleet.refine_chunk",
 })
 """Dotted knob paths the tuner may sweep/apply.  ``ring.*`` roots at a
 :class:`~das_diff_veh_tpu.config.RingConfig` (not part of PipelineConfig);
-everything else roots at :class:`~das_diff_veh_tpu.config.PipelineConfig`.
+everything else roots at :class:`~das_diff_veh_tpu.config.PipelineConfig`
+(``fleet.*`` at its :class:`~das_diff_veh_tpu.config.FleetInversionConfig`
+— inversion batch sizes, chunking-invariant by test pin).
 ``*.precision`` and all physics knobs are excluded by construction."""
 
 

@@ -156,6 +156,19 @@ def rescore_f64(spec, curves, x_best, n_grid: int = 600):
                                    invalid="penalty")(x))
         trunc = float(make_misfit_fn(spec, curves, n_grid=n_grid, n_subdiv=3,
                                      invalid="truncate")(x))
+        # fleet-engine cross-check: the packed masked misfit (segment
+        # reduction) must reproduce the closure oracle (static slicing) at
+        # the scored model in BOTH invalid modes, or the committed numbers
+        # would not transfer to invert_fleet
+        from das_diff_veh_tpu.inversion import (make_packed_misfit_fn,
+                                                pack_curve_sets)
+        data = jax.tree.map(lambda a: a[0], pack_curve_sets([curves]))
+        for mode, ref in (("penalty", pen), ("truncate", trunc)):
+            packed = float(make_packed_misfit_fn(
+                spec, n_grid=n_grid, n_subdiv=3, invalid=mode)(x, data))
+            if abs(packed - ref) > 1e-8 * max(1.0, abs(ref)):
+                raise AssertionError(
+                    f"packed {mode} misfit {packed!r} != closure {ref!r}")
         # below-cutoff count from ONE concatenated forward call (same shape
         # as the misfit's internal call -> shares its compiled executable)
         model = spec.to_model(x)

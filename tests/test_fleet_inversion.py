@@ -1,0 +1,319 @@
+"""Fleet inversion engine: packed misfit parity, one-program contract,
+credible intervals, and Vs change detection (inversion/fleet.py).
+
+Tier-1 budget note (ROADMAP): the module-scoped ``small_fleet`` fixture is
+the ONLY fresh fleet compile tier-1 pays here — every non-slow test reuses
+its result and its warm jit caches.  The multi-shape trace-count protocol,
+the mesh run, and the per-target ``invert_multirun`` equivalence each need
+additional compile sets and ride the ``slow`` marker.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from das_diff_veh_tpu.inversion import (Curve, LayerBounds, LayeredModel,
+                                        ModelSpec, density_gardner_linear,
+                                        invert_fleet, invert_multirun,
+                                        make_misfit_fn, make_packed_misfit_fn,
+                                        pack_curve_sets, phase_velocity,
+                                        speed_model_spec, vp_from_poisson,
+                                        weight_model_spec)
+from das_diff_veh_tpu.inversion.fleet import detect_vs_shifts
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# tiny CPU-smoke search budget shared by every fleet run in this module —
+# ONE budget => one compiled program set per (T, tc) shape
+BUDGET = dict(n_runs=2, popsize=5, maxiter=6, n_refine_starts=2,
+              n_refine_steps=5, n_grid=120)
+
+
+def _three_layer_spec():
+    return ModelSpec(layers=(LayerBounds((0.002, 0.012), (0.1, 0.3)),
+                             LayerBounds((0.01, 0.04), (0.25, 0.55)),
+                             LayerBounds((0.02, 0.08), (0.5, 1.0))))
+
+
+def _truth_model():
+    vs = jnp.asarray([0.20, 0.40, 0.70], dtype=jnp.float64)
+    vp = vp_from_poisson(vs, 0.4375)
+    return LayeredModel(thickness=jnp.asarray([0.006, 0.02, 0.0]), vp=vp,
+                        vs=vs, rho=density_gardner_linear(vp))
+
+
+def _curve_sets(n_targets, n_pts=12, seed=1, ragged=False):
+    """n_targets noisy bootstrap replicates of the truth's mode-0 curve.
+
+    ``ragged=True`` drops trailing points from every second target and adds
+    a short mode-1 overtone curve to the first, so packing actually pads.
+    """
+    periods = np.linspace(0.05, 0.4, n_pts)
+    c0 = np.asarray(phase_velocity(jnp.asarray(periods), _truth_model(),
+                                   mode=0, n_grid=400), dtype=np.float64)
+    rng = np.random.default_rng(seed)
+    sets = []
+    for t in range(n_targets):
+        n = n_pts - 3 if (ragged and t % 2) else n_pts
+        sets.append([Curve(periods[:n], c0[:n] + rng.normal(0, 0.005, n),
+                           mode=0, weight=1.0,
+                           uncertainty=0.01 * np.ones(n))])
+    if ragged and sets:
+        p1 = np.linspace(0.05, 0.12, 4)
+        c1 = np.asarray(phase_velocity(jnp.asarray(p1), _truth_model(),
+                                       mode=1, n_grid=400), dtype=np.float64)
+        sets[0].append(Curve(p1, c1, mode=1, weight=0.5,
+                             uncertainty=0.02 * np.ones(4)))
+    return sets
+
+
+@pytest.fixture(scope="module")
+def small_fleet():
+    """(spec, curve_sets, FleetResult) — the one tier-1 fleet compile."""
+    spec = _three_layer_spec()
+    sets = _curve_sets(3, ragged=True)
+    res = invert_fleet(spec, sets, seed=0, **BUDGET)
+    return spec, sets, res
+
+
+class TestPackCurveSets:
+    def test_padding_and_segments(self):
+        sets = _curve_sets(3, ragged=True)
+        cb = pack_curve_sets(sets)
+        assert cb.n_targets == 3
+        npts = [sum(len(c.period) for c in cs) for cs in sets]
+        assert cb.period.shape[1] == max(npts)
+        for t, n in enumerate(npts):
+            assert int(cb.valid[t].sum()) == n
+        # target 0 carries two curves -> two segment ids, weighted sum
+        assert int(cb.segment[0].max()) == 1
+        assert float(cb.wsum[0]) == pytest.approx(1.5)
+        # pad points are inert defaults (period 1, unc 1, weight row 0)
+        pad = ~np.asarray(cb.valid[1])
+        assert np.all(np.asarray(cb.period[1])[pad] == 1.0)
+
+    def test_capacity_pinning_and_errors(self):
+        sets = _curve_sets(2)
+        cb = pack_curve_sets(sets, max_points=40, max_curves=3)
+        assert cb.period.shape == (2, 40) and cb.weight.shape == (2, 3)
+        with pytest.raises(ValueError, match="capacity"):
+            pack_curve_sets(sets, max_points=4)
+        with pytest.raises(ValueError):
+            pack_curve_sets([])
+
+    def test_fixed_capacity_means_fixed_shapes(self):
+        a = pack_curve_sets(_curve_sets(2), max_points=30, max_curves=2)
+        b = pack_curve_sets(_curve_sets(2, ragged=True), max_points=30,
+                            max_curves=2)
+        assert a.period.shape == b.period.shape
+
+
+class TestPackedMisfitParity:
+    """The packed masked misfit IS the closure oracle, pointwise."""
+
+    @pytest.mark.parametrize("invalid", ["penalty", "truncate"])
+    def test_matches_closure_on_ragged_sets(self, invalid):
+        spec = _three_layer_spec()
+        sets = _curve_sets(3, ragged=True)
+        cb = pack_curve_sets(sets)
+        packed = make_packed_misfit_fn(spec, n_grid=120, invalid=invalid)
+        rng = np.random.default_rng(3)
+        xs = jnp.asarray(rng.uniform(0.05, 0.95, (4, spec.n_params)))
+        for t, cs in enumerate(sets):
+            closure = make_misfit_fn(spec, cs, n_grid=120, invalid=invalid)
+            data_t = jax.tree.map(lambda a: a[t], cb)
+            for x in xs:
+                np.testing.assert_allclose(float(packed(x, data_t)),
+                                           float(closure(x)),
+                                           rtol=1e-10, atol=1e-12)
+
+    def test_matches_closure_at_parity_best_models(self):
+        """Evaluate both misfits at the committed INVERSION_PARITY.json
+        ``x_best`` vectors — the exact models whose misfits are pinned —
+        on synthetic reference-band curve sets (the reference ridge data
+        is not shipped; pointwise agreement at the committed points is the
+        contract that transfers)."""
+        with open(os.path.join(_REPO, "INVERSION_PARITY.json")) as f:
+            parity = json.load(f)
+        cases = {"speed": speed_model_spec(), "weight": weight_model_spec()}
+        periods = np.asarray(1.0 / np.arange(2.0, 24.0, 1.5))[::-1]
+        for spec_name, spec in cases.items():
+            xs = [e["x_best"] for k, e in parity.items()
+                  if k.endswith(spec_name) and "x_best" in e][:2]
+            assert xs, f"no committed x_best for {spec_name}"
+            ref = spec.to_model(jnp.full(spec.n_params, 0.5))
+            vel = np.asarray(phase_velocity(jnp.asarray(periods), ref,
+                                            mode=0, n_grid=300))
+            keep = np.isfinite(vel)
+            curves = [Curve(periods[keep], vel[keep], mode=0, weight=1.0,
+                            uncertainty=0.02 * np.ones(keep.sum()))]
+            closure = make_misfit_fn(spec, curves, n_grid=300)
+            packed = make_packed_misfit_fn(spec, n_grid=300)
+            data = jax.tree.map(lambda a: a[0], pack_curve_sets([curves]))
+            for x in xs:
+                x = jnp.asarray(np.asarray(x, np.float64))
+                np.testing.assert_allclose(float(packed(x, data)),
+                                           float(closure(x)),
+                                           rtol=1e-10, atol=1e-12)
+
+
+class TestFleetResult:
+    def test_credible_intervals_ship_for_every_target(self, small_fleet):
+        _, sets, res = small_fleet
+        T = len(sets)
+        n_layers = 3
+        assert res.vs.shape == (T, n_layers)
+        assert res.vs_lo.shape == res.vs_hi.shape == (T, n_layers)
+        assert np.all(res.vs_lo <= res.vs) and np.all(res.vs <= res.vs_hi)
+        assert np.all(res.n_ensemble >= 1)
+        assert np.all(np.isfinite(res.misfit))
+        # convergence history is monotone non-increasing per target
+        assert np.all(np.diff(res.history, axis=1) <= 1e-12)
+
+    def test_uncertainty_never_loosens_misfit(self, small_fleet):
+        """The reported per-target misfit IS the closure oracle's score of
+        the reported best model — intervals annotate, never loosen."""
+        spec, sets, res = small_fleet
+        for t, cs in enumerate(sets):
+            oracle = float(make_misfit_fn(spec, cs, n_grid=120)(
+                jnp.asarray(res.x_best[t])))
+            np.testing.assert_allclose(res.misfit[t], oracle,
+                                       rtol=1e-9, atol=1e-12)
+            # and the ensemble members never beat the reported best
+            assert res.misfit[t] <= np.nanmin(res.misfits[t]) + 1e-12
+
+    def test_steady_state_zero_retrace(self, small_fleet):
+        """Same fleet shape again -> ZERO fresh jaxpr traces (the
+        one-program contract's steady state; the full T=1/3/5 invariance
+        protocol is the slow test below)."""
+        from das_diff_veh_tpu.obs import xla_events
+        from das_diff_veh_tpu.obs.registry import MetricsRegistry
+        spec, sets, _ = small_fleet
+        reg = MetricsRegistry()
+        watch = xla_events.install(reg)
+        try:
+            invert_fleet(spec, sets, seed=0, **BUDGET)
+        finally:
+            xla_events.uninstall(reg)
+        assert watch.traces == 0
+
+
+class TestChangeDetection:
+    def _shift(self, res, t, layer, delta):
+        vs = res.vs.copy()
+        vs[t, layer] += delta
+        return res._replace(vs=vs, vs_lo=vs - (res.vs - res.vs_lo),
+                            vs_hi=vs + (res.vs_hi - res.vs))
+
+    def test_detect_vs_shifts_events(self, small_fleet):
+        _, _, res = small_fleet
+        assert detect_vs_shifts(res, res) == []
+        big = float(res.vs_hi[1, 0] - res.vs[1, 0]) + 0.05
+        events = detect_vs_shifts(res, self._shift(res, 1, 0, big))
+        assert [(e.target, e.layer) for e in events] == [(1, 0)]
+        # a within-interval wiggle is NOT an event
+        small = float(res.vs_hi[1, 0] - res.vs[1, 0]) * 0.5
+        assert detect_vs_shifts(res, self._shift(res, 1, 0, small)) == []
+
+    def test_monitor_raises_counter_alarm_and_flight(self, small_fleet):
+        from das_diff_veh_tpu.obs.flight import FlightRecorder
+        from das_diff_veh_tpu.obs.registry import MetricsRegistry
+        from das_diff_veh_tpu.pipeline.timelapse import FleetVsMonitor
+        _, _, res = small_fleet
+        reg = MetricsRegistry()
+        fl = FlightRecorder(capacity=8)
+        mon = FleetVsMonitor(res, registry=reg, flight=fl,
+                             target_names=["t0", "t1", "t2"])
+        assert mon.observe(res) == []
+        assert reg.get("das_fleet_vs_alarm_active").labels(
+            target="t1").value == 0.0
+        big = float(res.vs_hi[1, 0] - res.vs[1, 0]) + 0.05
+        events = mon.observe(self._shift(res, 1, 0, big))
+        assert len(events) == 1
+        assert reg.get("das_fleet_vs_shift_total").labels(
+            target="t1").value == 1.0
+        assert reg.get("das_fleet_vs_alarm_active").labels(
+            target="t1").value == 1.0
+        assert reg.get("das_fleet_vs_alarm_active").labels(
+            target="t0").value == 0.0
+        recs = [r for r in fl.records() if r["kind"] == "vs_shift"]
+        assert len(recs) == 1 and recs[0]["target"] == "t1"
+        # recovery clears the alarm; rebase adopts a new baseline
+        mon.observe(res)
+        assert reg.get("das_fleet_vs_alarm_active").labels(
+            target="t1").value == 0.0
+        shifted = self._shift(res, 1, 0, big)
+        mon.rebase(shifted)
+        assert mon.observe(shifted) == []
+
+
+@pytest.mark.slow
+class TestFleetSlow:
+    """Multi-compile-set contracts: each distinct (T, tc) shape pays its
+    own compile on this 1-core host, so these ride the slow marker."""
+
+    def test_one_program_contract_trace_invariance(self):
+        """Fresh fleets of T=1, 3, and 5 targets trace the SAME number of
+        XLA programs, and a repeated shape traces zero."""
+        from das_diff_veh_tpu.obs import xla_events
+        from das_diff_veh_tpu.obs.registry import MetricsRegistry
+        spec = _three_layer_spec()
+        sets = _curve_sets(5)
+        # warm-up: first-touch scaffolding jits (shape-independent jnp
+        # internals) are traced once per process, not per fleet
+        invert_fleet(spec, sets[:2], seed=0, **BUDGET)
+
+        def traced(ss):
+            reg = MetricsRegistry()
+            watch = xla_events.install(reg)
+            try:
+                invert_fleet(spec, ss, seed=0, **BUDGET)
+            finally:
+                xla_events.uninstall(reg)
+            return watch.traces
+
+        t1, t3, t5, t3b = (traced(sets[:1]), traced(sets[:3]),
+                           traced(sets[:5]), traced(sets[:3]))
+        assert t1 == t3 == t5, (t1, t3, t5)
+        assert t3b == 0
+
+    def test_fleet_reproduces_per_target_multirun(self):
+        """Seeding contract: fleet target t == invert_multirun with
+        seed + t*n_runs on the same curves (same init, same chunk
+        stream)."""
+        spec = _three_layer_spec()
+        sets = _curve_sets(2)
+        res = invert_fleet(spec, sets, seed=7, **BUDGET)
+        for t, cs in enumerate(sets):
+            single = invert_multirun(spec, cs,
+                                     seed=7 + t * BUDGET["n_runs"], **BUDGET)
+            np.testing.assert_allclose(res.misfit[t], float(single.misfit),
+                                       rtol=1e-9)
+            np.testing.assert_allclose(res.x_best[t],
+                                       np.asarray(single.x_best), atol=1e-7)
+
+    def test_target_chunk_invariance(self):
+        """Chunked and unchunked fleets agree (chunk padding is inert)."""
+        spec = _three_layer_spec()
+        sets = _curve_sets(5)
+        base = invert_fleet(spec, sets, seed=0, **BUDGET)
+        chunked = invert_fleet(spec, sets, seed=0, target_chunk=2, **BUDGET)
+        np.testing.assert_allclose(chunked.misfit, base.misfit, rtol=5e-3)
+        np.testing.assert_allclose(chunked.x_best, base.x_best, atol=1e-6)
+
+    @pytest.mark.parallel
+    def test_sharded_matches_unsharded(self):
+        """Mesh-sharded target axis agrees with the single-device fleet
+        within the established test_inversion tolerance."""
+        mesh = jax.make_mesh((8,), ("win",))
+        spec = _three_layer_spec()
+        sets = _curve_sets(5)
+        base = invert_fleet(spec, sets, seed=0, **BUDGET)
+        sharded = invert_fleet(spec, sets, seed=0, mesh=mesh, **BUDGET)
+        np.testing.assert_allclose(sharded.misfit, base.misfit, rtol=5e-3)
+        np.testing.assert_allclose(sharded.x_best, base.x_best, atol=1e-7)
+        np.testing.assert_allclose(sharded.vs, base.vs, atol=1e-6)

@@ -260,6 +260,43 @@ class TestCurvePrep:
         d = load_reference_ridge_npz(str(p))
         assert set(d) == {"freqs", "freq_lb", "freq_ub"}
 
+    def test_single_bootstrap_repetition(self):
+        """One repetition: range and std collapse to zero and the
+        uncertainty floor (1e-4 km/s) takes over — a degenerate bootstrap
+        must not hand the misfit a divide-by-zero weight."""
+        freqs = np.linspace(2.0, 5.0, 4)
+        boot = np.asarray([[300.0, 310.0, 320.0, 330.0]])   # (1, nf)
+        mean, rng, std = ridge_stats(boot)
+        np.testing.assert_allclose(mean, boot[0])
+        np.testing.assert_allclose(rng, 0.0)
+        np.testing.assert_allclose(std, 0.0)
+        (c,) = curves_from_ridges(freqs, [2.0], [6.0], [boot], [0])
+        np.testing.assert_allclose(c.uncertainty, 1e-4)
+        np.testing.assert_allclose(c.velocity, boot[0][::-1] / 1000.0)
+
+    def test_descending_frequency_reversal(self):
+        """Band frequencies ascend -> periods 1/f would descend; the
+        reversal pins periods ASCENDING with velocities re-paired to their
+        original frequency samples (the evodcinv curve convention the
+        fleet packer inherits)."""
+        freqs = np.array([2.0, 4.0, 8.0])
+        boot = np.array([[200.0, 300.0, 400.0]])   # velocity per freq
+        (c,) = curves_from_ridges(freqs, [1.0], [10.0], [boot], [0])
+        assert np.all(np.diff(c.period) > 0)
+        np.testing.assert_allclose(c.period, [1 / 8.0, 1 / 4.0, 1 / 2.0])
+        # the 8 Hz sample (shortest period) keeps its 400 m/s velocity
+        np.testing.assert_allclose(c.velocity, [0.4, 0.3, 0.2])
+
+    def test_zero_uncertainty_guard(self):
+        """A band where SOME points have zero bootstrap spread floors only
+        those points at 1e-4; genuinely spread points keep their range."""
+        freqs = np.array([2.0, 4.0])
+        boot = np.array([[300.0, 340.0], [300.0, 360.0]])
+        (c,) = curves_from_ridges(freqs, [1.0], [5.0], [boot], [0])
+        # reversed: index 0 is the 4 Hz point (20 m/s spread), index 1 the
+        # 2 Hz point (zero spread -> floored)
+        np.testing.assert_allclose(c.uncertainty, [0.020, 1e-4])
+
 
 def test_multirun_sharded_over_mesh_matches_unsharded():
     """Restart axis sharded over the 8-virtual-device CPU mesh matches the
